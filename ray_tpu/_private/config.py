@@ -1,0 +1,106 @@
+"""System config flag table.
+
+TPU-native analog of the reference's RAY_CONFIG X-macro table
+(reference: src/ray/common/ray_config_def.h — 174 flags materialized into a
+RayConfig singleton, overridable via RAY_* env vars and
+ray.init(_system_config={...})).  Same semantics here: a declarative table,
+`RAY_TPU_<NAME>` env overrides, and a `_system_config` dict at init that is
+serialized down to every spawned process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+# name -> (type, default, help)
+_CONFIG_DEF: Dict[str, tuple] = {
+    # -- timeouts / heartbeats (reference: ray_config_def.h:56-59) --
+    "heartbeat_period_ms": (int, 500, "worker/node heartbeat period"),
+    "num_heartbeats_timeout": (int, 30, "missed heartbeats before a node is dead"),
+    "worker_register_timeout_s": (float, 30.0, "max wait for a worker to register"),
+    "connect_timeout_s": (float, 10.0, "TCP connect timeout to head"),
+    "rpc_timeout_s": (float, 60.0, "generic control-RPC timeout"),
+    # -- scheduling --
+    "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduler tick"),
+    "scheduler_spread_threshold": (float, 0.5, "hybrid policy: utilization above which we spread"),
+    "scheduler_top_k_fraction": (float, 0.2, "hybrid policy: fraction of nodes in the top-k set"),
+    "worker_pool_min_idle": (int, 0, "prestarted idle workers per node"),
+    "worker_pool_max_workers": (int, 64, "hard cap of worker processes per node"),
+    "idle_worker_kill_s": (float, 300.0, "kill idle workers after this long"),
+    # -- objects --
+    "max_direct_call_object_size": (int, 100 * 1024, "objects <= this inline in the owner store"),
+    "object_store_memory": (int, 512 * 1024 * 1024, "default shm store capacity (bytes)"),
+    "object_transfer_chunk_bytes": (int, 5 * 1024 * 1024, "chunk size for node-to-node object push"),
+    "fetch_warn_timeout_s": (float, 30.0, "warn if an object fetch stalls this long"),
+    # -- fault tolerance --
+    "task_max_retries": (int, 3, "default retries for normal tasks"),
+    "actor_max_restarts": (int, 0, "default restarts for actors"),
+    "lineage_max_bytes": (int, 64 * 1024 * 1024, "max lineage kept per owner for reconstruction"),
+    # -- collective / tpu --
+    "collective_rendezvous_timeout_s": (float, 120.0, "GCS-KV rendezvous wait"),
+    "dcn_allreduce_chunk_bytes": (int, 4 * 1024 * 1024, "ring-allreduce chunk over DCN"),
+    "tpu_slice_resource_name": (str, "TPU", "resource key for tpu chips"),
+    # -- logging / metrics --
+    "event_loop_lag_warn_ms": (int, 500, "warn if the control loop stalls"),
+    "metrics_report_period_ms": (int, 2000, "metrics push period"),
+    # -- serve --
+    "serve_long_poll_timeout_s": (float, 30.0, "long-poll listen timeout"),
+    "serve_queue_length_response_deadline_s": (float, 0.1, "router queue probe deadline"),
+}
+
+
+class _Config:
+    """Singleton holding resolved config values."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self.reset()
+
+    def reset(self):
+        self._values = {name: default for name, (_, default, _h) in _CONFIG_DEF.items()}
+        for name, (typ, _default, _h) in _CONFIG_DEF.items():
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is not None:
+                self._values[name] = self._parse(typ, env)
+
+    @staticmethod
+    def _parse(typ, raw: str):
+        if typ is bool:
+            return raw.lower() in ("1", "true", "yes")
+        return typ(raw)
+
+    def initialize(self, system_config: Dict[str, Any] | None):
+        """Apply `_system_config` overrides (e.g. from init / spawned-process env)."""
+        if not system_config:
+            return
+        for k, v in system_config.items():
+            if k not in _CONFIG_DEF:
+                raise ValueError(f"Unknown system config: {k!r}")
+            typ = _CONFIG_DEF[k][0]
+            self._values[k] = self._parse(typ, v) if isinstance(v, str) else typ(v)
+
+    def to_json(self) -> str:
+        return json.dumps(self._values)
+
+    def initialize_from_json(self, blob: str):
+        self.initialize(json.loads(blob))
+
+    def __getattr__(self, name):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name)
+
+
+RayConfig = _Config()
+
+
+def describe_flags() -> str:
+    lines = []
+    for name, (typ, default, help_) in sorted(_CONFIG_DEF.items()):
+        lines.append(f"{name} ({typ.__name__}, default {default!r}): {help_}")
+    return "\n".join(lines)

@@ -1,0 +1,198 @@
+"""Wire protocol for the control plane.
+
+The reference uses gRPC + protobuf for every control RPC
+(reference: src/ray/rpc/grpc_server.h, src/ray/protobuf/*.proto).  We keep
+the same *message taxonomy* (register node/worker, lease, push task, task
+done, object location, KV, pubsub, heartbeat) but carry it as
+length-prefixed msgpack frames over asyncio TCP sockets — simpler, no IDL
+step, and fast enough for a control plane whose hot data path lives in
+shared memory and on the TPU ICI fabric anyway.
+
+Frame layout: 4-byte little-endian length, then a msgpack array
+``[msg_type:int, request_id:int, payload:map]``.  request_id pairs requests
+with replies on a single multiplexed connection (the analog of gRPC call
+tags in the reference's ClientCallManager, src/ray/rpc/client_call.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import itertools
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import msgpack
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class MsgType(enum.IntEnum):
+    # replies
+    REPLY = 0
+    ERROR_REPLY = 1
+
+    # node / worker lifecycle (analog: node_manager.proto, gcs_service.proto)
+    REGISTER_NODE = 10
+    REGISTER_WORKER = 11
+    HEARTBEAT = 12
+    NODE_TABLE = 13
+    DRAIN_NODE = 14
+
+    # tasks (analog: core_worker.proto PushTask, node_manager RequestWorkerLease)
+    SUBMIT_TASK = 20
+    PUSH_TASK = 21
+    TASK_DONE = 22
+    CANCEL_TASK = 23
+    STEAL_OK = 24
+
+    # actors (analog: gcs_service.proto ActorInfoGcsService)
+    CREATE_ACTOR = 30
+    ACTOR_CALL = 31
+    GET_ACTOR = 32
+    KILL_ACTOR = 33
+    ACTOR_STATE = 34
+    LIST_ACTORS = 35
+
+    # objects (analog: object_manager.proto, core_worker GetObjectStatus)
+    PUT_OBJECT = 40
+    GET_OBJECT = 41
+    FREE_OBJECT = 42
+    OBJECT_LOCATION = 43
+    WAIT_OBJECT = 44
+    ADD_REF = 45
+    REMOVE_REF = 46
+    PIN_OBJECT = 47
+
+    # KV + pubsub (analog: gcs_kv_manager.h, pubsub.proto)
+    KV_PUT = 50
+    KV_GET = 51
+    KV_DEL = 52
+    KV_KEYS = 53
+    KV_EXISTS = 54
+    SUBSCRIBE = 55
+    PUBLISH = 56
+    PUBSUB_POLL = 57
+
+    # placement groups (analog: gcs_service.proto PlacementGroupInfoGcsService)
+    CREATE_PG = 60
+    REMOVE_PG = 61
+    GET_PG = 62
+    PG_READY = 63
+    LIST_PGS = 64
+
+    # jobs / cluster state (analog: gcs_service.proto JobInfoGcsService)
+    REGISTER_JOB = 70
+    CLUSTER_RESOURCES = 71
+    AVAILABLE_RESOURCES = 72
+    LIST_NODES = 73
+    LIST_TASKS = 74
+    TIMELINE = 75
+
+    # errors pushed to driver
+    ERROR_PUSH = 80
+
+
+def _default(obj):
+    raise TypeError(f"Unserializable control-plane value: {type(obj)}")
+
+
+def pack(msg_type: int, request_id: int, payload: Dict[str, Any]) -> bytes:
+    body = msgpack.packb([int(msg_type), request_id, payload], use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+def unpack(body: bytes) -> Tuple[int, int, Dict[str, Any]]:
+    msg_type, request_id, payload = msgpack.unpackb(body, raw=False, strict_map_key=False)
+    return msg_type, request_id, payload
+
+
+class Connection:
+    """A multiplexed request/reply + push connection over one TCP socket.
+
+    Both ends can issue requests; unsolicited pushes use request_id 0.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._req_ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, host: str, port: int, timeout: float = 10.0) -> "Connection":
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        try:
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                import socket as _s
+
+                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return cls(reader, writer)
+
+    async def send(self, msg_type: int, payload: Dict[str, Any], request_id: int = 0):
+        data = pack(msg_type, request_id, payload)
+        async with self._write_lock:
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def request(
+        self, msg_type: int, payload: Dict[str, Any], timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Send a request and await the paired reply (run read_loop elsewhere)."""
+        rid = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self.send(msg_type, payload, rid)
+            return await (asyncio.wait_for(fut, timeout) if timeout else fut)
+        finally:
+            self._pending.pop(rid, None)
+
+    async def reply(self, request_id: int, payload: Dict[str, Any], error: str = None):
+        if error is not None:
+            await self.send(MsgType.ERROR_REPLY, {"error": error}, request_id)
+        else:
+            await self.send(MsgType.REPLY, payload, request_id)
+
+    def dispatch_reply(self, msg_type: int, request_id: int, payload: Dict[str, Any]) -> bool:
+        """Route an incoming frame to a pending request future. Returns True if consumed."""
+        fut = self._pending.get(request_id)
+        if fut is None or fut.done():
+            return False
+        if msg_type == MsgType.ERROR_REPLY:
+            fut.set_exception(ConnectionError(payload.get("error", "remote error")))
+        else:
+            fut.set_result(payload)
+        return True
+
+    async def read_frame(self) -> Tuple[int, int, Dict[str, Any]]:
+        hdr = await self.reader.readexactly(_LEN.size)
+        (n,) = _LEN.unpack(hdr)
+        if n > MAX_FRAME:
+            raise ConnectionError(f"frame too large: {n}")
+        body = await self.reader.readexactly(n)
+        return unpack(body)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("connection closed"))
+            self._pending.clear()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,114 @@
+"""Object serialization.
+
+Analog of the reference's pickle5 + out-of-band-buffer scheme
+(reference: python/ray/_private/serialization.py — cloudpickle protocol 5
+with zero-copy numpy buffers landing in plasma).  Values are pickled with
+cloudpickle protocol 5; large contiguous buffers (numpy arrays, and JAX
+arrays via a lazy copyreg hook) are captured out-of-band so they can be
+placed in / read from the shared-memory object store without a copy.
+"""
+
+from __future__ import annotations
+
+import copyreg
+import pickle
+import sys
+from dataclasses import dataclass, field
+from typing import Any, List, Sequence
+
+import cloudpickle
+import numpy as np
+
+# Metadata tags (analog: ray_constants OBJECT_METADATA_TYPE_*)
+META_PICKLE = b"py"
+META_RAW = b"raw"  # value is raw bytes, stored as-is, zero-copy
+META_TASK_ERROR = b"err"
+META_ACTOR_HANDLE = b"actor"
+
+_jax_reducer_installed = False
+
+
+def _maybe_install_jax_reducer():
+    """Register a reducer for jax.Array the first time jax shows up.
+
+    Device arrays are pulled to host as numpy (which pickles out-of-band,
+    zero-copy) and re-materialized with jnp.asarray on load.  Importing jax
+    eagerly in every worker would add seconds of startup, so this only
+    fires once jax is already in sys.modules.
+    """
+    global _jax_reducer_installed
+    if _jax_reducer_installed or "jax" not in sys.modules:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    def _rebuild(np_value):
+        return jnp.asarray(np_value)
+
+    def _reduce_jax_array(arr):
+        return (_rebuild, (np.asarray(arr),))
+
+    try:
+        copyreg.pickle(jax.Array, _reduce_jax_array)
+        # concrete ArrayImpl class is what instances actually carry
+        impl = type(jax.numpy.zeros(()))
+        copyreg.pickle(impl, _reduce_jax_array)
+    except Exception:
+        pass
+    _jax_reducer_installed = True
+
+
+@dataclass
+class SerializedObject:
+    """A value split into metadata, in-band pickle bytes, and raw buffers."""
+
+    metadata: bytes
+    inband: bytes
+    buffers: List[memoryview] = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(b.nbytes for b in self.buffers)
+
+    def to_wire(self) -> list:
+        """msgpack-compatible representation (copies buffers)."""
+        return [self.metadata, self.inband, [bytes(b) for b in self.buffers]]
+
+    @classmethod
+    def from_wire(cls, wire: Sequence) -> "SerializedObject":
+        meta, inband, bufs = wire
+        return cls(bytes(meta), bytes(inband), [memoryview(b) for b in bufs])
+
+
+def serialize(value: Any) -> SerializedObject:
+    _maybe_install_jax_reducer()
+    if isinstance(value, bytes):
+        return SerializedObject(META_RAW, b"", [memoryview(value)])
+    buffers: List[pickle.PickleBuffer] = []
+    inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = []
+    for pb in buffers:
+        try:
+            views.append(pb.raw())
+        except BufferError:
+            # non-contiguous buffer: force a contiguous copy
+            views.append(memoryview(bytes(pb)))
+    return SerializedObject(META_PICKLE, inband, views)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    _maybe_install_jax_reducer()
+    if obj.metadata == META_RAW:
+        return bytes(obj.buffers[0]) if obj.buffers else b""
+    value = pickle.loads(obj.inband, buffers=obj.buffers)
+    return value
+
+
+def dumps(value: Any) -> bytes:
+    """Flat single-buffer form, for control-plane payloads."""
+    _maybe_install_jax_reducer()
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    _maybe_install_jax_reducer()
+    return pickle.loads(data)

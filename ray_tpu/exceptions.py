@@ -1,0 +1,126 @@
+"""Public exception hierarchy.
+
+Mirrors the reference's user-visible error taxonomy
+(reference: python/ray/exceptions.py — RayError, RayTaskError,
+RayActorError, ObjectLostError, GetTimeoutError, …) so code written against
+the reference maps one-to-one.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayError):
+    """A task raised an exception; the traceback is carried to the caller.
+
+    Stored *as the value* of the task's return objects so that `get` on any
+    downstream consumer re-raises it (same contagion semantics as the
+    reference: python/ray/exceptions.py RayTaskError.as_instanceof_cause).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Task {function_name} failed:\n{traceback_str}")
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: Exception):
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, cause=exc)
+
+    def as_instanceof_cause(self):
+        """Return an exception that is also an instance of the cause's class."""
+        cause = self.cause
+        if cause is None or isinstance(cause, RayTaskError):
+            return self
+        cause_cls = type(cause)
+        if cause_cls is RayTaskError:
+            return self
+        try:
+            derived = type(
+                "RayTaskError(" + cause_cls.__name__ + ")",
+                (RayTaskError, cause_cls),
+                {"__init__": lambda s: None},
+            )
+            err = derived()
+            err.function_name = self.function_name
+            err.traceback_str = self.traceback_str
+            err.cause = cause
+            err.args = (f"Task {self.function_name} failed:\n{self.traceback_str}",)
+            return err
+        except TypeError:
+            return self
+
+
+class TaskCancelledError(RayError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class RayActorError(RayError):
+    """The actor died before or during this method call."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(f"Actor {actor_id}: {reason}")
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayError):
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id}: {reason}")
+
+
+class ObjectStoreFullError(RayError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class WorkerCrashedError(RayError):
+    pass
+
+
+class NodeDiedError(RayError):
+    pass
+
+
+class RaySystemError(RayError):
+    pass
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class PlacementGroupError(RayError):
+    pass
+
+
+class CrossLanguageError(RayError):
+    pass

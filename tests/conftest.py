@@ -1,0 +1,39 @@
+"""Test config: force a virtual 8-device CPU mesh so all sharding/collective
+logic is exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench.py runs on the real chip)."""
+
+import os
+
+# Must be set before jax import anywhere in the test process.  Force cpu even
+# if the ambient env says "axon" (the single-TPU tunnel): tests never touch
+# the real chip, and a second TPU claim would deadlock against bench runs.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RAY_TPU_TESTING", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def shutdown_only():
+    """Analog of the reference's shutdown_only fixture
+    (reference: python/ray/tests/conftest.py:194)."""
+    yield None
+    import ray_tpu
+
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular(request):
+    """Analog of ray_start_regular (reference: python/ray/tests/conftest.py:244)."""
+    import ray_tpu
+
+    kwargs = getattr(request, "param", {})
+    info = ray_tpu.init(num_cpus=4, **kwargs)
+    yield info
+    ray_tpu.shutdown()

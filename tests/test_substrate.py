@@ -1,0 +1,100 @@
+"""Substrate tests: ids, config, protocol framing, serialization."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import ids, protocol, serialization
+from ray_tpu._private.config import RayConfig
+
+
+def test_id_roundtrip():
+    job = ids.JobID.from_int(7)
+    assert job.int() == 7
+    actor = ids.ActorID.of(job)
+    assert actor.job_id() == job
+    task = ids.TaskID.for_actor_task(actor)
+    assert task.actor_id() == actor
+    obj = ids.ObjectID.for_task_return(task, 2)
+    assert obj.task_id() == task
+    assert obj.return_index() == 2
+    assert not obj.is_put()
+    put = ids.ObjectID.for_put(task, 5)
+    assert put.is_put() and put.return_index() == 5
+    assert ids.NodeID.from_hex(ids.NodeID.from_random().hex())
+
+
+def test_id_equality_hash():
+    a = ids.NodeID.from_random()
+    b = ids.NodeID(a.binary())
+    assert a == b and hash(a) == hash(b)
+    assert a != ids.NodeID.from_random()
+    assert ids.NodeID.nil().is_nil()
+
+
+def test_config_defaults_and_overrides():
+    assert RayConfig.num_heartbeats_timeout == 30
+    RayConfig.initialize({"num_heartbeats_timeout": 5})
+    assert RayConfig.num_heartbeats_timeout == 5
+    blob = RayConfig.to_json()
+    RayConfig.reset()
+    assert RayConfig.num_heartbeats_timeout == 30
+    RayConfig.initialize_from_json(blob)
+    assert RayConfig.num_heartbeats_timeout == 5
+    RayConfig.reset()
+    with pytest.raises(ValueError):
+        RayConfig.initialize({"not_a_flag": 1})
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "9")
+    RayConfig.reset()
+    assert RayConfig.task_max_retries == 9
+    monkeypatch.delenv("RAY_TPU_TASK_MAX_RETRIES")
+    RayConfig.reset()
+
+
+def test_protocol_pack_unpack():
+    frame = protocol.pack(protocol.MsgType.SUBMIT_TASK, 42, {"a": b"x", "n": 3})
+    mt, rid, payload = protocol.unpack(frame[4:])
+    assert mt == protocol.MsgType.SUBMIT_TASK
+    assert rid == 42
+    assert payload == {"a": b"x", "n": 3}
+
+
+def test_serialize_roundtrip_basic():
+    for v in [1, "s", None, {"k": [1, 2, (3, 4)]}, b"raw-bytes", 3.5]:
+        s = serialization.serialize(v)
+        out = serialization.deserialize(serialization.SerializedObject.from_wire(s.to_wire()))
+        assert out == v
+
+
+def test_serialize_numpy_out_of_band():
+    arr = np.arange(1 << 16, dtype=np.float32).reshape(256, 256)
+    s = serialization.serialize(arr)
+    # big array must travel out-of-band, not inside the pickle stream
+    assert sum(b.nbytes for b in s.buffers) >= arr.nbytes
+    assert len(s.inband) < 10_000
+    out = serialization.deserialize(s)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_serialize_jax_array():
+    import jax.numpy as jnp
+
+    x = jnp.arange(128.0)
+    s = serialization.serialize({"x": x})
+    out = serialization.deserialize(serialization.SerializedObject.from_wire(s.to_wire()))
+    np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(x))
+
+
+def test_serialize_closure():
+    z = 10
+
+    def f(x):
+        return x + z
+
+    s = serialization.serialize(f)
+    g = serialization.deserialize(s)
+    assert g(5) == 15
