@@ -95,11 +95,13 @@ class MsgType(enum.IntEnum):
 
 
 def _default(obj):
-    raise TypeError(f"Unserializable control-plane value: {type(obj)}")
+    raise TypeError(f"Unserializable control-plane value: {type(obj)!r}")
 
 
 def pack(msg_type: int, request_id: int, payload: Dict[str, Any]) -> bytes:
-    body = msgpack.packb([int(msg_type), request_id, payload], use_bin_type=True)
+    body = msgpack.packb(
+        [int(msg_type), request_id, payload], use_bin_type=True, default=_default
+    )
     return _LEN.pack(len(body)) + body
 
 

@@ -1,0 +1,67 @@
+"""Task specification — the unit handed from submitter to scheduler to executor.
+
+Analog of the reference's TaskSpecification (reference:
+src/ray/common/task/task_spec.h and protobuf common.proto TaskSpec), carrying
+function identity, arguments (inline values or object refs), resource
+demands, retry policy, and placement-group affinity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+NORMAL_TASK = "normal"
+ACTOR_CREATION_TASK = "actor_creation"
+ACTOR_TASK = "actor_task"
+
+# Argument wire encodings
+ARG_VALUE = 0  # inline SerializedObject wire form
+ARG_REF = 1  # object id bytes — resolved by the executor before running
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    job_id: bytes
+    task_type: str = NORMAL_TASK
+    # sha1 of the exported function/class blob in the GCS function table
+    function_id: bytes = b""
+    function_name: str = ""
+    method_name: str = ""  # actor tasks
+    actor_id: Optional[bytes] = None
+    args: List[list] = field(default_factory=list)  # [[ARG_VALUE, wire] | [ARG_REF, id]]
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retries_left: int = 0
+    # actor creation options
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: str = ""  # named actor
+    namespace: str = ""
+    detached: bool = False
+    # placement
+    pg_id: Optional[bytes] = None
+    pg_bundle_index: int = -1
+    node_affinity: Optional[bytes] = None  # node id, soft=false only
+    seq_no: int = 0  # per-caller ordering for actor tasks
+    caller_id: bytes = b""
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    # set when the worker owning this actor should claim the real TPU chip
+    claim_tpu: bool = False
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "TaskSpec":
+        return cls(**d)
+
+    def return_object_ids(self) -> List[bytes]:
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        tid = TaskID(self.task_id)
+        return [
+            ObjectID.for_task_return(tid, i).binary() for i in range(self.num_returns)
+        ]

@@ -1,0 +1,254 @@
+"""Python client for the native shared-memory object store.
+
+Analog of the reference's plasma client (reference:
+src/ray/object_manager/plasma/client.cc) but with direct segment mapping
+instead of a unix-socket protocol: every process mmaps the same tmpfs file
+and calls into ``libray_tpu_store.so`` (src/object_store/store.cc) under a
+process-shared robust mutex.  Sealed objects are immutable; ``get`` returns
+zero-copy memoryviews into the mapping, pinned (refcounted) for as long as
+any consumer view is alive via PEP-688 buffer-protocol exporters.
+
+Object payload layout (one store object per framework object):
+  u32 header_len | msgpack [metadata, inband_len, [buffer_lens]] |
+  inband bytes | 64-pad | buffer0 | 64-pad | buffer1 | ...
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+from typing import List, Optional
+
+import msgpack
+
+from ray_tpu._private.build_native import ensure_lib
+from ray_tpu._private.serialization import SerializedObject
+
+_U32 = struct.Struct("<I")
+_ALIGN = 64
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class _Lib:
+    _instance = None
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            lib = ctypes.CDLL(ensure_lib("store"))
+            lib.store_create.restype = ctypes.c_void_p
+            lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+            lib.store_attach.restype = ctypes.c_void_p
+            lib.store_attach.argtypes = [ctypes.c_char_p]
+            lib.store_detach.argtypes = [ctypes.c_void_p]
+            lib.store_alloc.restype = ctypes.c_int
+            lib.store_alloc.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.store_seal.restype = ctypes.c_int
+            lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.store_get.restype = ctypes.c_int
+            lib.store_get.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            for name in ("store_release", "store_contains", "store_delete", "store_abort"):
+                f = getattr(lib, name)
+                f.restype = ctypes.c_int
+                f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            for name in (
+                "store_capacity",
+                "store_used",
+                "store_num_objects",
+                "store_evictions",
+                "store_mapped_size",
+            ):
+                f = getattr(lib, name)
+                f.restype = ctypes.c_uint64
+                f.argtypes = [ctypes.c_void_p]
+            cls._instance = lib
+        return cls._instance
+
+
+class _PinnedRegion:
+    """Buffer-protocol exporter that releases the store pin when collected.
+
+    numpy arrays built over slices of ``memoryview(region)`` keep the region
+    alive, so the pin (store refcount) outlives every zero-copy consumer —
+    the moral equivalent of plasma's client-side release tracking
+    (reference: plasma/client.cc Release).
+    """
+
+    def __init__(self, store: "ShmObjectStore", oid: bytes, view: memoryview):
+        self._store = store
+        self._oid = oid
+        self._view = view
+
+    def __buffer__(self, flags):
+        return self._view.__buffer__(flags)
+
+    def __del__(self):
+        try:
+            self._store.release(self._oid)
+        except Exception:
+            pass
+
+
+class ShmObjectStore:
+    """One per process; head creates the segment, workers attach."""
+
+    def __init__(self, path: str, capacity: int = 0, create: bool = False, nslots: int = 65536):
+        self._lib = _Lib.get()
+        self._path = path
+        if create:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._handle = self._lib.store_create(path.encode(), capacity, nslots)
+        else:
+            self._handle = self._lib.store_attach(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot {'create' if create else 'attach'} shm store at {path}")
+        size = self._lib.store_mapped_size(self._handle)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._mm)
+
+    ID_LEN = 28  # must match kIdLen in src/object_store/store.cc
+
+    def _check(self, object_id: bytes):
+        if self._handle is None:
+            raise OSError("shm store is closed")
+        if len(object_id) != self.ID_LEN:
+            raise ValueError(f"object id must be {self.ID_LEN} bytes, got {len(object_id)}")
+
+    # -- framework-object API -------------------------------------------------
+
+    def put_serialized(self, object_id: bytes, obj: SerializedObject) -> bool:
+        """Write + seal. Returns False if the object already exists."""
+        self._check(object_id)
+        header = msgpack.packb(
+            [obj.metadata, len(obj.inband), [b.nbytes for b in obj.buffers]],
+            use_bin_type=True,
+        )
+        prefix = _U32.size + len(header) + len(obj.inband)
+        total = _pad(prefix)
+        for b in obj.buffers:
+            total += _pad(b.nbytes)
+        off = ctypes.c_uint64()
+        rc = self._lib.store_alloc(self._handle, object_id, total, ctypes.byref(off))
+        if rc == -1:
+            return False
+        if rc != 0:
+            raise MemoryError(
+                f"shm store cannot fit object of {total} bytes "
+                f"(used {self.used()}/{self.capacity()})"
+            )
+        base = off.value
+        try:
+            view = self._mv[base : base + total]
+            pos = 0
+            view[pos : pos + _U32.size] = _U32.pack(len(header))
+            pos += _U32.size
+            view[pos : pos + len(header)] = header
+            pos += len(header)
+            if obj.inband:
+                view[pos : pos + len(obj.inband)] = obj.inband
+            pos = _pad(pos + len(obj.inband))
+            for b in obj.buffers:
+                if b.nbytes:
+                    if b.format == "B" and b.ndim == 1:
+                        flat = b
+                    else:
+                        try:
+                            flat = b.cast("B")  # zero-copy for contiguous views
+                        except TypeError:
+                            flat = memoryview(bytes(b))
+                    view[pos : pos + b.nbytes] = flat
+                pos = _pad(pos + b.nbytes)
+            del view
+        except BaseException:
+            # roll back the unsealed allocation so the id isn't wedged forever
+            self._lib.store_abort(self._handle, object_id)
+            raise
+        if self._lib.store_seal(self._handle, object_id) != 0:
+            self._lib.store_abort(self._handle, object_id)
+            raise RuntimeError("seal failed")
+        self._lib.store_release(self._handle, object_id)  # drop creator pin
+        return True
+
+    def get_serialized(self, object_id: bytes) -> Optional[SerializedObject]:
+        """Zero-copy read of a sealed object; None if absent/unsealed."""
+        self._check(object_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, object_id, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        region = _PinnedRegion(self, object_id, self._mv[off.value : off.value + size.value])
+        view = memoryview(region)
+        (hlen,) = _U32.unpack(view[: _U32.size])
+        pos = _U32.size
+        metadata, inband_len, buf_lens = msgpack.unpackb(
+            bytes(view[pos : pos + hlen]), raw=False
+        )
+        pos += hlen
+        inband = bytes(view[pos : pos + inband_len])
+        pos = _pad(pos + inband_len)
+        buffers: List[memoryview] = []
+        for blen in buf_lens:
+            buffers.append(view[pos : pos + blen])
+            pos = _pad(pos + blen)
+        return SerializedObject(bytes(metadata), inband, buffers)
+
+    # -- raw ops --------------------------------------------------------------
+
+    def contains(self, object_id: bytes) -> bool:
+        if not self._handle:
+            return False
+        return bool(self._lib.store_contains(self._handle, object_id))
+
+    def release(self, object_id: bytes):
+        if self._handle:
+            self._lib.store_release(self._handle, object_id)
+
+    def delete(self, object_id: bytes):
+        if self._handle:
+            self._lib.store_delete(self._handle, object_id)
+
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self._handle) if self._handle else 0
+
+    def used(self) -> int:
+        return self._lib.store_used(self._handle) if self._handle else 0
+
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self._handle) if self._handle else 0
+
+    def evictions(self) -> int:
+        return self._lib.store_evictions(self._handle) if self._handle else 0
+
+    def close(self):
+        """Detach.  If zero-copy views are still alive we must NOT unmap the
+        segment under them — leave the mapping to the process teardown."""
+        if self._handle:
+            handle, self._handle = self._handle, None
+            try:
+                self._mv.release()
+                self._mm.close()
+            except BufferError:
+                # outstanding exported views: skip munmap, only free the
+                # client bookkeeping at exit (the OS reclaims the mapping)
+                return
+            self._lib.store_detach(handle)
