@@ -1,1 +1,60 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+The public core API keeps the reference's contract (reference:
+python/ray/__init__.py — init/shutdown, @remote, get/put/wait, actors,
+placement groups) while the internals are built TPU-first: jax/XLA for the
+compute plane, a native shared-memory object store, and ICI-mesh
+collectives instead of NCCL.
+"""
+
 __version__ = "0.1.0"
+
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.worker import (  # noqa: F401
+    cancel,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+
+def cluster_resources():
+    from ray_tpu._private import worker as _w
+
+    return _w._require_connected().cluster_resources()
+
+
+def available_resources():
+    from ray_tpu._private import worker as _w
+
+    return _w._require_connected().available_resources()
+
+
+def nodes():
+    from ray_tpu._private import worker as _w
+
+    out = []
+    for n in _w._require_connected().list_nodes():
+        out.append(
+            {
+                "NodeID": n["node_id"].hex(),
+                "Alive": n["alive"],
+                "Resources": n["resources"],
+                "Available": n["available"],
+                "Labels": n.get("labels", {}),
+            }
+        )
+    return out
+
+
+# Submodules commonly accessed as attributes (ray.util.*, ray.air.* style)
+from ray_tpu import util  # noqa: F401, E402

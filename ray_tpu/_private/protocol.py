@@ -46,6 +46,8 @@ class MsgType(enum.IntEnum):
     TASK_DONE = 22
     CANCEL_TASK = 23
     STEAL_OK = 24
+    TASK_BLOCKED = 25  # worker blocked in get(): release its cpu (analog:
+    TASK_UNBLOCKED = 26  # reference NotifyDirectCallTaskBlocked, raylet_client.cc)
 
     # actors (analog: gcs_service.proto ActorInfoGcsService)
     CREATE_ACTOR = 30

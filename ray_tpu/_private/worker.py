@@ -1,0 +1,260 @@
+"""Driver-side global worker state + init/shutdown + get/put/wait.
+
+Analog of the reference's python/ray/_private/worker.py (init:1031,
+connect:1853, get:2200, put:2313, wait:2369, shutdown:1567): owns the head
+process lifecycle on the driver node and the process-global CoreWorker.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class Worker:
+    """Process-global runtime handle (reference: worker.py global_worker)."""
+
+    def __init__(self):
+        self.core_worker = None
+        self.mode: Optional[str] = None  # driver | worker | None
+        self.head_proc: Optional[subprocess.Popen] = None
+        self.session_dir: str = ""
+        self.address: str = ""
+
+    @property
+    def connected(self) -> bool:
+        return self.core_worker is not None and self.core_worker.connected
+
+
+global_worker = Worker()
+
+
+def _detect_tpu_chips() -> int:
+    """How many TPU chips this host owns (the head node's TPU resource)."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env is not None:
+        return int(env)
+    # Under axon there is one tunneled chip; probing jax here would claim it,
+    # so only trust explicit signals.
+    if os.environ.get("TPU_SKIP_MDS_QUERY") or os.environ.get("TPU_WORKER_ID"):
+        return 1
+    return 0
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: str = "",
+    runtime_env: Optional[dict] = None,
+    _system_config: Optional[dict] = None,
+    ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
+    **kwargs,
+) -> "RuntimeContext":
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    Reference semantics: python/ray/_private/worker.py:1031.
+    """
+    from ray_tpu.runtime_context import RuntimeContext
+
+    if global_worker.connected:
+        if ignore_reinit_error:
+            return RuntimeContext(global_worker)
+        raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+
+    RayConfig.initialize(_system_config)
+
+    if address in (None, "local"):
+        host, port = _start_head(
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory,
+            system_config=_system_config,
+        )
+    else:
+        if address == "auto":
+            address = os.environ.get("RAY_TPU_ADDRESS", "")
+            if not address:
+                raise ConnectionError("address='auto' but RAY_TPU_ADDRESS is not set")
+        host, port_s = address.rsplit(":", 1)
+        port = int(port_s)
+
+    from ray_tpu.core.core_worker import CoreWorker
+
+    worker_env = {}
+    if _system_config:
+        worker_env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(_system_config)
+    cw = CoreWorker(host, port, mode="driver", worker_env=worker_env)
+    global_worker.core_worker = cw
+    global_worker.mode = "driver"
+    global_worker.address = f"{host}:{port}"
+    global_worker.namespace = namespace
+    atexit.register(shutdown)
+    return RuntimeContext(global_worker)
+
+
+def _start_head(
+    num_cpus=None,
+    num_tpus=None,
+    resources=None,
+    object_store_memory=None,
+    system_config=None,
+) -> Tuple[str, int]:
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    tpus = num_tpus if num_tpus is not None else _detect_tpu_chips()
+    if tpus:
+        res[RayConfig.tpu_slice_resource_name] = float(tpus)
+    session_dir = os.path.join(
+        "/tmp/ray_tpu", f"session_{int(time.time() * 1000)}_{os.getpid()}"
+    )
+    os.makedirs(session_dir, exist_ok=True)
+    global_worker.session_dir = session_dir
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu.gcs.head_main",
+        "--session-dir",
+        session_dir,
+        "--resources",
+        json.dumps(res),
+    ]
+    if object_store_memory:
+        cmd += ["--object-store-memory", str(object_store_memory)]
+    env = dict(os.environ)
+    if system_config:
+        env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(system_config)
+    log_path = os.path.join(session_dir, "head.log")
+    logf = open(log_path, "ab")
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
+    )
+    global_worker.head_proc = proc
+    # wait for "PORT <n>"
+    deadline = time.time() + 30
+    line = b""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith(b"PORT "):
+            return "127.0.0.1", int(line.split()[1])
+        if proc.poll() is not None:
+            break
+        time.sleep(0.01)
+    raise RuntimeError(
+        f"head process failed to start (see {log_path}): {line.decode(errors='replace')}"
+    )
+
+
+def shutdown():
+    """Tear down the driver connection and the head we own
+    (reference: worker.py:1567)."""
+    cw = global_worker.core_worker
+    if cw is not None:
+        try:
+            cw.disconnect()
+        except Exception:
+            pass
+        global_worker.core_worker = None
+    proc = global_worker.head_proc
+    if proc is not None:
+        try:
+            proc.terminate()
+            proc.wait(timeout=5)
+        except Exception:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        global_worker.head_proc = None
+    global_worker.mode = None
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def _require_connected():
+    if not global_worker.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return global_worker.core_worker
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
+) -> Any:
+    cw = _require_connected()
+    if isinstance(refs, ObjectRef):
+        return cw.get([refs], timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("ray_tpu.get() accepts an ObjectRef or a list of ObjectRefs")
+        return cw.get(list(refs), timeout)
+    raise TypeError(f"cannot get() {type(refs)}")
+
+
+def put(value: Any) -> ObjectRef:
+    cw = _require_connected()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed (reference parity)")
+    return cw.put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    cw = _require_connected()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns > len(refs)")
+    return cw.wait(list(refs), num_returns, timeout, fetch_local)
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    cw = _require_connected()
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    cw.kill_actor(actor_handle._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    cw = _require_connected()
+    cw.cancel_task(ref.task_id().binary(), force)
+
+
+def get_actor(name: str, namespace: str = ""):
+    from ray_tpu.actor import ActorHandle
+
+    cw = _require_connected()
+    reply = cw.get_named_actor(name, namespace)
+    if not reply.get("found"):
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    from ray_tpu._private.task_spec import TaskSpec
+
+    spec = TaskSpec.from_wire(reply["creation_spec"])
+    return ActorHandle._from_spec(spec, cw)

@@ -1,0 +1,149 @@
+"""In-one-machine cluster harness for distributed-behavior tests.
+
+Analog of the reference's ray.cluster_utils.Cluster (reference:
+python/ray/cluster_utils.py:99 — add_node:165, remove_node:238): one head
+process + N raylet processes on this machine, the backbone of multi-node
+scheduling/failure tests without real hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class NodeHandle:
+    def __init__(self, proc: subprocess.Popen, node_id_hex: str):
+        self.proc = proc
+        self.node_id = node_id_hex
+
+    def kill(self, force: bool = False):
+        try:
+            if force:
+                self.proc.kill()
+            else:
+                self.proc.terminate()
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[Dict] = None,
+        connect: bool = False,
+    ):
+        self.head_proc: Optional[subprocess.Popen] = None
+        self.worker_nodes: List[NodeHandle] = []
+        self.address = ""
+        self.session_dir = os.path.join(
+            "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+        if initialize_head:
+            self._start_head(head_node_args or {})
+        if connect:
+            import ray_tpu
+
+            ray_tpu.init(address=self.address)
+
+    def _start_head(self, args: Dict):
+        res = {}
+        if "num_cpus" in args:
+            res["CPU"] = float(args["num_cpus"])
+        if "num_tpus" in args:
+            res["TPU"] = float(args["num_tpus"])
+        res.update(args.get("resources", {}))
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.gcs.head_main",
+            "--session-dir",
+            self.session_dir,
+            "--resources",
+            json.dumps(res),
+        ]
+        logf = open(os.path.join(self.session_dir, "head.log"), "ab")
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
+        )
+        self.head_proc = proc
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith(b"PORT "):
+                self.address = f"127.0.0.1:{int(line.split()[1])}"
+                return
+            if proc.poll() is not None:
+                break
+        raise RuntimeError(f"cluster head failed to start (see {self.session_dir}/head.log)")
+
+    def add_node(
+        self,
+        num_cpus: float = 4,
+        num_tpus: float = 0,
+        resources: Optional[Dict[str, float]] = None,
+        **kwargs,
+    ) -> NodeHandle:
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        res.setdefault("memory", 4.0 * (1 << 30))
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.raylet.raylet_main",
+            "--head",
+            self.address,
+            "--resources",
+            json.dumps(res),
+            "--session-dir",
+            self.session_dir,
+        ]
+        logf = open(os.path.join(self.session_dir, "raylet.log"), "ab")
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith(b"NODE "):
+                handle = NodeHandle(proc, line.split()[1].decode())
+                self.worker_nodes.append(handle)
+                return handle
+            if proc.poll() is not None:
+                break
+        raise RuntimeError("raylet failed to start")
+
+    def remove_node(self, node: NodeHandle, allow_graceful: bool = True):
+        node.kill(force=not allow_graceful)
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+
+    def shutdown(self):
+        import ray_tpu
+
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for node in list(self.worker_nodes):
+            node.kill(force=True)
+        self.worker_nodes.clear()
+        if self.head_proc is not None:
+            try:
+                self.head_proc.terminate()
+                self.head_proc.wait(timeout=5)
+            except Exception:
+                try:
+                    self.head_proc.kill()
+                except Exception:
+                    pass
+            self.head_proc = None

@@ -1,0 +1,600 @@
+"""CoreWorker: the in-process runtime for drivers and workers.
+
+Analog of the reference's C++ CoreWorker (reference:
+src/ray/core_worker/core_worker.cc — SubmitTask:1617, Put:923, Get:1130,
+Wait:1268, CreateActor:1680, SubmitActorTask:1913) plus its Cython binding
+(python/ray/_raylet.pyx:1253).  Each process owns one CoreWorker holding:
+
+- a multiplexed TCP connection to the head (control plane), serviced by a
+  dedicated asyncio thread (the analog of the reference's io_service threads)
+- an attachment to the node-local shared-memory object store (data plane)
+- local reference counting with batched release to the head (the
+  owner-centralized form of reference reference_count.cc)
+- the function table client (export/fetch via head KV, analog of
+  python/ray/_private/function_manager.py)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.protocol import Connection, MsgType
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu._private.task_spec import (
+    ACTOR_CREATION_TASK,
+    ACTOR_TASK,
+    ARG_REF,
+    ARG_VALUE,
+    NORMAL_TASK,
+    TaskSpec,
+)
+from ray_tpu.core.shm_store import ShmObjectStore
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RaySystemError,
+    RayTaskError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+_ERROR_CLASSES = {
+    "RayActorError": RayActorError,
+    "ActorDiedError": ActorDiedError,
+    "TaskCancelledError": TaskCancelledError,
+    "WorkerCrashedError": WorkerCrashedError,
+    "SchedulingError": RaySystemError,
+    "ObjectLostError": ObjectLostError,
+}
+
+
+def _error_from_string(msg: str) -> Exception:
+    head, _, rest = msg.partition(":")
+    cls = _ERROR_CLASSES.get(head.strip())
+    if cls is RayActorError or cls is ActorDiedError:
+        return cls(reason=rest.strip() or msg)
+    if cls is TaskCancelledError:
+        return TaskCancelledError()
+    if cls:
+        try:
+            return cls(rest.strip() or msg)
+        except TypeError:
+            pass
+    return RaySystemError(msg)
+
+
+class _EventLoopThread:
+    """Dedicated asyncio loop thread servicing the head connection."""
+
+    def __init__(self, name: str = "ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _halt():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_halt)
+        self._thread.join(timeout=5)
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        head_host: str,
+        head_port: int,
+        mode: str,  # "driver" | "worker"
+        job_id: Optional[JobID] = None,
+        node_id: Optional[bytes] = None,
+        store_path: Optional[str] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.mode = mode
+        self.job_id = job_id or JobID.from_int(os.getpid() & 0xFFFFFFFF)
+        self.worker_id = WorkerID.from_random()
+        self.node_id = node_id
+        self.head_host, self.head_port = head_host, head_port
+        self.current_task_id: Optional[bytes] = None  # set by the executor
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self._local_refs: Dict[bytes, int] = {}
+        self._refs_lock = threading.Lock()
+        self._pending_removals: List[bytes] = []
+        self._exported_functions: Dict[bytes, bool] = {}
+        self._fetched_functions: Dict[bytes, Any] = {}
+        self._actor_seq: Dict[bytes, int] = {}
+        self._push_task_handler: Optional[Callable[[dict], None]] = None
+        self._early_pushes: List[dict] = []  # frames that raced handler setup
+        self._subscriptions: Dict[str, Callable[[dict], None]] = {}
+        self.connected = False
+
+        self.io = _EventLoopThread()
+        self.conn: Connection = self.io.call(
+            Connection.connect(head_host, head_port, RayConfig.connect_timeout_s)
+        )
+        self.store: Optional[ShmObjectStore] = None
+        self.io.spawn(self._read_loop())
+        self.io.spawn(self._gc_flush_loop())
+        self.connected = True
+        if mode == "driver":
+            self.register_as_driver(worker_env or {})
+
+    # ------------------------------------------------------------- plumbing
+
+    def request(self, msg_type, payload, timeout: Optional[float] = None):
+        """Synchronous control RPC from any thread."""
+        return self.io.call(
+            self.conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
+        )
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg_type, rid, payload = await self.conn.read_frame()
+                if self.conn.dispatch_reply(msg_type, rid, payload):
+                    continue
+                if msg_type == MsgType.PUSH_TASK:
+                    if self._push_task_handler:
+                        self._push_task_handler(payload)
+                    else:
+                        self._early_pushes.append(payload)
+                elif msg_type == MsgType.PUBLISH:
+                    cb = self._subscriptions.get(payload.get("channel", ""))
+                    if cb:
+                        try:
+                            cb(payload.get("message", {}))
+                        except Exception:
+                            pass
+                elif msg_type == MsgType.CANCEL_TASK and self._push_task_handler:
+                    self._push_task_handler({"cancel": payload.get("task_id")})
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self.connected = False
+
+    async def _gc_flush_loop(self):
+        while True:
+            await asyncio.sleep(0.2)
+            batch = None
+            with self._refs_lock:
+                if self._pending_removals:
+                    batch, self._pending_removals = self._pending_removals, []
+            if batch:
+                try:
+                    await self.conn.request(MsgType.REMOVE_REF, {"object_ids": batch}, 10)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- refcounts
+
+    def _add_local_ref(self, oid: bytes):
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+            first = n == 0
+        if first and self.connected:
+            try:
+                self.io.spawn(self.conn.request(MsgType.ADD_REF, {"object_ids": [oid]}, 10))
+            except Exception:
+                pass
+
+    def _remove_local_ref(self, oid: bytes):
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n <= 0:
+                self._local_refs.pop(oid, None)
+                self._pending_removals.append(oid)
+            else:
+                self._local_refs[oid] = n
+
+    # ------------------------------------------------------------ functions
+
+    def export_function(self, fn_or_class: Any) -> Tuple[bytes, str]:
+        """Ship a function/class definition to the head KV function table
+        (analog: reference function_manager.py export via GCS KV)."""
+        blob = serialization.dumps(fn_or_class)
+        fid = hashlib.sha1(blob).digest()[:16]
+        if fid not in self._exported_functions:
+            key = f"fn:{fid.hex()}"
+            self.request(MsgType.KV_PUT, {"key": key, "value": blob, "overwrite": False})
+            self._exported_functions[fid] = True
+        name = getattr(fn_or_class, "__name__", str(fn_or_class))
+        return fid, name
+
+    def fetch_function(self, function_id: bytes) -> Any:
+        fn = self._fetched_functions.get(function_id)
+        if fn is not None:
+            return fn
+        key = f"fn:{function_id.hex()}"
+        reply = self.request(
+            MsgType.KV_GET, {"key": key, "wait": True, "timeout": 30}, timeout=35
+        )
+        if not reply.get("found"):
+            raise RaySystemError(f"function {function_id.hex()} not found in table")
+        fn = serialization.loads(reply["value"])
+        self._fetched_functions[function_id] = fn
+        return fn
+
+    # --------------------------------------------------------------- objects
+
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        task_id = (
+            TaskID(self.current_task_id)
+            if self.current_task_id
+            else TaskID.for_driver_task(self.job_id)
+        )
+        oid = ObjectID.for_put(task_id, idx).binary()
+        self.put_object(oid, serialization.serialize(value))
+        return ObjectRef(oid, self)
+
+    def put_object(self, oid: bytes, sobj: SerializedObject):
+        if not self.store.put_serialized(oid, sobj):
+            pass  # already present (idempotent put)
+        self.request(MsgType.PUT_OBJECT, {"object_id": oid})
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        out: List[Any] = [None] * len(refs)
+        pending: List[Tuple[int, bytes]] = []
+        for i, ref in enumerate(refs):
+            oid = ref.binary() if isinstance(ref, ObjectRef) else bytes(ref)
+            sobj = self.store.get_serialized(oid)
+            if sobj is not None:
+                out[i] = self._materialize(sobj)
+            else:
+                pending.append((i, oid))
+        if pending:
+            self._notify_blocked(True)
+            try:
+                for i, oid in pending:
+                    rem = None
+                    if deadline is not None:
+                        rem = max(0.0, deadline - time.monotonic())
+                    reply = self.request(
+                        MsgType.WAIT_OBJECT,
+                        {"object_id": oid, "timeout": rem},
+                        timeout=(rem + 5) if rem is not None else 3600,
+                    )
+                    state = reply.get("state")
+                    if state == "timeout":
+                        raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
+                    if state == "error":
+                        raise _error_from_string(reply.get("error", "task failed"))
+                    sobj = self.store.get_serialized(oid)
+                    if sobj is None:
+                        raise ObjectLostError(oid.hex(), "sealed but missing from store (evicted?)")
+                    out[i] = self._materialize(sobj)
+            finally:
+                self._notify_blocked(False)
+        return out
+
+    def _materialize(self, sobj: SerializedObject) -> Any:
+        value = serialization.deserialize(sobj)
+        if isinstance(value, RayTaskError):
+            raise value.as_instanceof_cause()
+        return value
+
+    def _notify_blocked(self, blocked: bool):
+        if self.mode != "worker" or not self.current_task_id:
+            return
+        try:
+            self.io.spawn(
+                self.conn.send(
+                    MsgType.TASK_BLOCKED if blocked else MsgType.TASK_UNBLOCKED,
+                    {"task_id": self.current_task_id},
+                )
+            )
+        except Exception:
+            pass
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """One blocking server-side wait (h_wait_object batch form) instead
+        of client polling — the head wakes us on seal."""
+        ready_idx = set()
+        pending_ids = []
+        for i, ref in enumerate(refs):
+            if self.store.contains(ref.binary()):
+                ready_idx.add(i)
+            else:
+                pending_ids.append((i, ref.binary()))
+        if len(ready_idx) < num_returns and pending_ids:
+            reply = self.request(
+                MsgType.WAIT_OBJECT,
+                {
+                    "object_ids": [oid for _, oid in pending_ids],
+                    "num_ready": num_returns - len(ready_idx),
+                    "timeout": timeout,
+                },
+                timeout=(timeout + 10) if timeout is not None else 3600,
+            )
+            sealed = {bytes(o) for o in reply.get("ready", [])}
+            for i, oid in pending_ids:
+                if oid in sealed:
+                    ready_idx.add(i)
+        ready, not_ready = [], []
+        for i, ref in enumerate(refs):
+            (ready if i in ready_idx and len(ready) < num_returns else not_ready).append(ref)
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]):
+        self.request(MsgType.FREE_OBJECT, {"object_ids": [r.binary() for r in refs]})
+
+    # ----------------------------------------------------------------- tasks
+
+    def submit_task(
+        self,
+        function_id: bytes,
+        function_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int,
+        resources: Dict[str, float],
+        max_retries: int,
+        pg_id: Optional[bytes],
+        pg_bundle_index: int,
+        node_affinity: Optional[bytes] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.for_normal_task(self.job_id)
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            task_type=NORMAL_TASK,
+            function_id=function_id,
+            function_name=function_name,
+            args=self._encode_args(args, kwargs),
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=max_retries,
+            retries_left=max_retries,
+            pg_id=pg_id,
+            pg_bundle_index=pg_bundle_index,
+            node_affinity=node_affinity,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env or {},
+        )
+        self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
+        return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
+
+    def create_actor(
+        self,
+        actor_id: bytes,
+        function_id: bytes,
+        class_name: str,
+        args: tuple,
+        kwargs: dict,
+        resources: Dict[str, float],
+        max_restarts: int,
+        max_concurrency: int,
+        name: str,
+        namespace: str,
+        detached: bool,
+        pg_id: Optional[bytes],
+        pg_bundle_index: int,
+        runtime_env: Optional[dict] = None,
+    ) -> ObjectRef:
+        from ray_tpu._private.ids import ActorID
+
+        task_id = TaskID.for_actor_creation(ActorID(actor_id))
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            task_type=ACTOR_CREATION_TASK,
+            function_id=function_id,
+            function_name=class_name,
+            actor_id=actor_id,
+            args=self._encode_args(args, kwargs),
+            num_returns=1,
+            resources=resources,
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            name=name or "",
+            namespace=namespace or "",
+            detached=detached,
+            pg_id=pg_id,
+            pg_bundle_index=pg_bundle_index,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env or {},
+        )
+        self.request(MsgType.CREATE_ACTOR, {"spec": spec.to_wire()})
+        return ObjectRef(spec.return_object_ids()[0], self)
+
+    def submit_actor_task(
+        self,
+        actor_id: bytes,
+        function_id: bytes,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int,
+    ) -> List[ObjectRef]:
+        from ray_tpu._private.ids import ActorID
+
+        seq = self._actor_seq.get(actor_id, 0)
+        self._actor_seq[actor_id] = seq + 1
+        task_id = TaskID.for_actor_task(ActorID(actor_id))
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            task_type=ACTOR_TASK,
+            function_id=function_id,
+            method_name=method_name,
+            actor_id=actor_id,
+            args=self._encode_args(args, kwargs),
+            num_returns=num_returns,
+            seq_no=seq,
+            caller_id=self.worker_id.binary(),
+        )
+        self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
+        return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
+
+    def _encode_args(self, args: tuple, kwargs: dict) -> List[list]:
+        """Inline small values; put large ones in the store and pass refs
+        (reference: direct-call arg inlining, max_direct_call_object_size)."""
+        encoded: List[list] = []
+        limit = RayConfig.max_direct_call_object_size
+        items = [(False, a) for a in args] + [(k, v) for k, v in kwargs.items()]
+        for key, value in items:
+            if isinstance(value, ObjectRef):
+                encoded.append([ARG_REF, key if key else None, value.binary()])
+                continue
+            sobj = serialization.serialize(value)
+            if sobj.total_bytes() <= limit:
+                encoded.append([ARG_VALUE, key if key else None, sobj.to_wire()])
+            else:
+                ref = self.put(value)
+                encoded.append([ARG_REF, key if key else None, ref.binary()])
+        return encoded
+
+    def decode_args(self, encoded: List[list]) -> Tuple[tuple, dict]:
+        args: List[Any] = []
+        kwargs: Dict[str, Any] = {}
+        for kind, key, payload in encoded:
+            if kind == ARG_VALUE:
+                value = serialization.deserialize(SerializedObject.from_wire(payload))
+            else:
+                value = self.get([ObjectRef(bytes(payload), None)])[0]
+            if key:
+                kwargs[key] = value
+            else:
+                args.append(value)
+        return tuple(args), kwargs
+
+    # ----------------------------------------------------- actors / cluster
+
+    def get_named_actor(self, name: str, namespace: str):
+        return self.request(MsgType.GET_ACTOR, {"name": name, "namespace": namespace})
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self.request(MsgType.KILL_ACTOR, {"actor_id": actor_id, "no_restart": no_restart})
+
+    def cancel_task(self, task_id: bytes, force: bool = False):
+        self.request(MsgType.CANCEL_TASK, {"task_id": task_id, "force": force})
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.request(MsgType.KV_PUT, {"key": key, "value": value, "overwrite": overwrite})[
+            "added"
+        ]
+
+    def kv_get(self, key: str, wait: bool = False, timeout: Optional[float] = None) -> Optional[bytes]:
+        reply = self.request(
+            MsgType.KV_GET,
+            {"key": key, "wait": wait, "timeout": timeout},
+            timeout=(timeout or RayConfig.rpc_timeout_s) + 5,
+        )
+        return reply["value"] if reply.get("found") else None
+
+    def kv_del(self, key: str, prefix: bool = False) -> int:
+        return self.request(MsgType.KV_DEL, {"key": key, "prefix": prefix})["deleted"]
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.request(MsgType.KV_KEYS, {"prefix": prefix})["keys"]
+
+    def subscribe(self, channel: str, callback: Callable[[dict], None]):
+        self._subscriptions[channel] = callback
+        self.request(MsgType.SUBSCRIBE, {"channel": channel})
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.request(MsgType.CLUSTER_RESOURCES, {})["resources"]
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.request(MsgType.AVAILABLE_RESOURCES, {})["resources"]
+
+    def list_nodes(self) -> List[dict]:
+        return self.request(MsgType.LIST_NODES, {})["nodes"]
+
+    # ---------------------------------------------------------------- admin
+
+    def attach_store(self, store_path: str):
+        self.store = ShmObjectStore(store_path, create=False)
+
+    def set_push_task_handler(self, handler: Callable[[dict], None]):
+        self._push_task_handler = handler
+        early, self._early_pushes = self._early_pushes, []
+        for payload in early:
+            handler(payload)
+
+    def register_as_worker(self, node_id: bytes, pid: int, has_tpu: bool = False):
+        reply = self.request(
+            MsgType.REGISTER_WORKER,
+            {
+                "worker_id": self.worker_id.binary(),
+                "node_id": node_id,
+                "pid": pid,
+                "has_tpu": has_tpu,
+            },
+        )
+        self.node_id = node_id
+        self.attach_store(reply["store_path"])
+        return reply
+
+    def register_as_driver(self, worker_env: Dict[str, str]):
+        reply = self.request(
+            MsgType.REGISTER_JOB,
+            {
+                "job_id": self.job_id.binary(),
+                "pid": os.getpid(),
+                "worker_env": worker_env,
+            },
+        )
+        self.node_id = reply["node_id"]
+        self.attach_store(reply["store_path"])
+        return reply
+
+    def task_done(self, task_id: bytes, sealed: List[bytes], error: Optional[str], stored_error: bool):
+        self.io.call(
+            self.conn.send(
+                MsgType.TASK_DONE,
+                {
+                    "task_id": task_id,
+                    "sealed": sealed,
+                    "error": error,
+                    "stored_error": stored_error,
+                },
+            )
+        )
+
+    def disconnect(self):
+        self.connected = False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        try:
+            if self.store:
+                self.store.close()
+        except Exception:
+            pass
+        self.io.stop()

@@ -1,0 +1,212 @@
+"""Worker process main: execute tasks pushed by the head.
+
+Analog of the reference's default_worker.py + the C++ task execution loop
+(reference: python/ray/_private/workers/default_worker.py,
+src/ray/core_worker/core_worker.cc RunTaskExecutionLoop:2176 /
+ExecuteTask:2231, and the Cython execute_task upcall _raylet.pyx:596).
+
+A worker is either a pool worker (runs one normal task at a time) or an
+actor-dedicated worker (holds the instance; executes its method calls in
+submission order, or concurrently up to max_concurrency, or on an asyncio
+loop for async actors — the analog of reference concurrency groups /
+fiber-based async actors, src/ray/core_worker/transport/
+concurrency_group_manager.cc + fiber.h).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
+from ray_tpu.exceptions import RayTaskError
+
+
+class _ActorState:
+    def __init__(self):
+        self.instance: Any = None
+        self.cls: Any = None
+        self.async_loop: Optional[Any] = None  # asyncio loop for async actors
+        self.executor: Optional[ThreadPoolExecutor] = None
+
+
+class WorkerRuntime:
+    def __init__(self, core_worker):
+        self.cw = core_worker
+        self.actor = _ActorState()
+        self.task_queue: "queue.Queue[dict]" = queue.Queue()
+        self.cancelled: set = set()
+        self._concurrency_sem: Optional[threading.Semaphore] = None
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self):
+        """Pull pushed tasks off the queue and execute (the analog of
+        RunTaskExecutionLoop)."""
+        while True:
+            payload = self.task_queue.get()
+            if payload is None:
+                break
+            if "cancel" in payload:
+                self.cancelled.add(payload["cancel"])
+                continue
+            spec = TaskSpec.from_wire(payload["spec"])
+            if spec.task_type == ACTOR_TASK and self._concurrency_sem is not None:
+                # concurrent actor: run in the pool, keep pulling
+                self.actor.executor.submit(self._execute_guarded, spec)
+            else:
+                self._execute_guarded(spec)
+
+    def on_push(self, payload: dict):
+        """Called from the io thread; never block it."""
+        if payload.get("directive"):
+            return  # spawn directives are raylet business, not ours
+        self.task_queue.put(payload)
+
+    # ------------------------------------------------------------ execution
+
+    def _execute_guarded(self, spec: TaskSpec):
+        sealed: List[bytes] = []
+        error: Optional[str] = None
+        stored_error = False
+        try:
+            if spec.task_id in self.cancelled:
+                raise RayTaskError(
+                    spec.function_name or spec.method_name,
+                    "TaskCancelledError: cancelled",
+                )
+            results = self._execute(spec)
+            outs = self._normalize_returns(spec, results)
+            for oid, value in outs:
+                self.cw.store.put_serialized(oid, serialization.serialize(value))
+                sealed.append(oid)
+        except BaseException as e:  # noqa: BLE001
+            name = spec.function_name or spec.method_name
+            if isinstance(e, RayTaskError):
+                err = e
+            else:
+                err = RayTaskError.from_exception(name, e)
+            error = f"{type(e).__name__}: {e}"
+            # store the error as the value of every return object
+            try:
+                for oid in spec.return_object_ids():
+                    self.cw.store.put_serialized(oid, serialization.serialize(err))
+                    sealed.append(oid)
+                stored_error = True
+            except BaseException:
+                stored_error = False
+            traceback.print_exc(file=sys.stderr)
+        finally:
+            self.cw.current_task_id = None
+        try:
+            self.cw.task_done(spec.task_id, sealed, error, stored_error)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            os._exit(1)  # lost the head: die, the head treats it as worker death
+
+    def _execute(self, spec: TaskSpec):
+        self.cw.current_task_id = spec.task_id
+        args, kwargs = self.cw.decode_args(spec.args)
+        if spec.task_type == NORMAL_TASK:
+            fn = self.cw.fetch_function(spec.function_id)
+            return fn(*args, **kwargs)
+        if spec.task_type == ACTOR_CREATION_TASK:
+            cls = self.cw.fetch_function(spec.function_id)
+            self.actor.cls = cls
+            if spec.max_concurrency > 1:
+                self.actor.executor = ThreadPoolExecutor(max_workers=spec.max_concurrency)
+                self._concurrency_sem = threading.Semaphore(spec.max_concurrency)
+            if _is_async_actor(cls):
+                self._start_async_loop()
+            self.actor.instance = cls(*args, **kwargs)
+            return None
+        if spec.task_type == ACTOR_TASK:
+            inst = self.actor.instance
+            if inst is None:
+                raise RuntimeError("actor instance not initialized")
+            if spec.method_name == "_ray_tpu_init_collective":
+                # driver-side create_collective_group() trampoline: join the
+                # group in this actor's process (reference analog: declared
+                # groups lazily initialized inside each actor,
+                # collective.py:151)
+                from ray_tpu.util.collective import init_collective_group
+
+                world_size, rank, backend, group_name = args
+                init_collective_group(world_size, rank, backend, group_name)
+                return None
+            method = getattr(inst, spec.method_name)
+            if inspect.iscoroutinefunction(getattr(method, "__func__", method)):
+                import asyncio
+
+                fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), self.actor.async_loop)
+                return fut.result()
+            return method(*args, **kwargs)
+        raise ValueError(f"unknown task type {spec.task_type}")
+
+    def _normalize_returns(self, spec: TaskSpec, results: Any):
+        oids = spec.return_object_ids()
+        if spec.num_returns == 1:
+            return [(oids[0], results)]
+        if results is None:
+            results = [None] * spec.num_returns
+        results = list(results)
+        if len(results) != spec.num_returns:
+            raise ValueError(
+                f"task declared num_returns={spec.num_returns} but returned {len(results)} values"
+            )
+        return list(zip(oids, results))
+
+    def _start_async_loop(self):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        self.actor.async_loop = loop
+        t = threading.Thread(target=loop.run_forever, name="actor-async", daemon=True)
+        t.start()
+
+
+def _is_async_actor(cls) -> bool:
+    return any(
+        inspect.iscoroutinefunction(m)
+        for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
+    )
+
+
+def main():
+    host, port = os.environ["RAY_TPU_HEAD"].split(":")
+    node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
+    from ray_tpu._private.config import RayConfig
+
+    if os.environ.get("RAY_TPU_SYSTEM_CONFIG"):
+        RayConfig.initialize_from_json(os.environ["RAY_TPU_SYSTEM_CONFIG"])
+
+    from ray_tpu.core.core_worker import CoreWorker
+
+    cw = CoreWorker(host, int(port), mode="worker")
+    runtime = WorkerRuntime(cw)
+    # handler must be live BEFORE registering: the head pushes the first task
+    # the moment registration lands
+    cw.set_push_task_handler(runtime.on_push)
+    cw.register_as_worker(
+        node_id, os.getpid(), has_tpu=bool(os.environ.get("RAY_TPU_WORKER_TPU"))
+    )
+
+    # mark this process as a connected worker for nested API calls
+    from ray_tpu._private import worker as worker_mod
+
+    worker_mod.global_worker.core_worker = cw
+    worker_mod.global_worker.mode = "worker"
+
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

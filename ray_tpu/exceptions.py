@@ -34,6 +34,18 @@ class RayTaskError(RayError):
         tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
         return cls(function_name, tb, cause=exc)
 
+    def __reduce__(self):
+        # The cause crosses process boundaries only if it pickles; the
+        # traceback string always survives (reference keeps the same rule).
+        cause = self.cause
+        try:
+            import pickle
+
+            pickle.dumps(cause)
+        except Exception:
+            cause = None
+        return (RayTaskError, (self.function_name, self.traceback_str, cause))
+
     def as_instanceof_cause(self):
         """Return an exception that is also an instance of the cause's class."""
         cause = self.cause

@@ -1,0 +1,64 @@
+"""Head process entry: runs the HeadServer until killed.
+
+Analog of the reference's `gcs_server` binary entry
+(reference: src/ray/gcs/gcs_server/gcs_server_main.cc) — spawned by
+ray_tpu.init() on the driver node or by `ray-tpu start --head`.
+Prints ``PORT <n>`` on stdout once listening so the parent can connect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+
+async def _amain(args):
+    from ray_tpu._private.config import RayConfig
+
+    if args.system_config:
+        RayConfig.initialize_from_json(args.system_config)
+    from ray_tpu.gcs.server import HeadServer
+
+    server = HeadServer(
+        host=args.host,
+        port=args.port,
+        resources=json.loads(args.resources) if args.resources else None,
+        session_dir=args.session_dir,
+        store_capacity=args.object_store_memory,
+    )
+    port = await server.start()
+    print(f"PORT {port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="")
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--system-config", default=os.environ.get("RAY_TPU_SYSTEM_CONFIG", ""))
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=args.log_level,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+        stream=sys.stderr,
+    )
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1339 @@
+"""Head server: cluster metadata authority + scheduler + object directory.
+
+TPU-native analog of the reference's GCS server + raylet control logic
+(reference: src/ray/gcs/gcs_server/gcs_server.cc — NodeInfo/ActorInfo/
+PlacementGroupInfo/JobInfo/KV/Pubsub services; src/ray/raylet/
+node_manager.cc + scheduling/cluster_task_manager.cc for leasing and
+dispatch).  One asyncio process serves:
+
+- node registry + worker pool directives (spawn/kill) per node
+- cluster task scheduling (hybrid pack/spread policy, resource accounting)
+- actor directory + FSM (pending → alive → restarting/dead), named actors
+- placement groups (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD) with resource
+  reservation and bundle accounting
+- object directory (pending → sealed/error) with waiter wakeup
+- cluster-wide KV (function table, collective rendezvous), pubsub channels
+
+Design deltas from the reference, deliberate for the TPU era:
+- Control is a star over length-prefixed msgpack/TCP instead of per-pair
+  gRPC meshes; the data plane (tensors) never touches it — large values live
+  in the node-local shared-memory store (src/object_store/store.cc) and move
+  across chips over ICI via jax collectives, not through this server.
+- Scheduling decisions are centralized here rather than spilled-back raylet
+  to raylet (reference cluster_task_manager.cc:80): with slice-aligned TPU
+  topology the global view is what placement quality needs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.protocol import Connection, MsgType
+from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Object table states (analog: reference object directory + task states)
+PENDING, SEALED, ERRORED = 0, 1, 2
+
+# Actor FSM states (reference: gcs_actor_manager.cc state machine)
+ACTOR_PENDING, ACTOR_ALIVE, ACTOR_RESTARTING, ACTOR_DEAD = (
+    "PENDING_CREATION",
+    "ALIVE",
+    "RESTARTING",
+    "DEAD",
+)
+
+
+class WorkerInfo:
+    __slots__ = (
+        "worker_id",
+        "node_id",
+        "conn",
+        "pid",
+        "idle",
+        "actor_id",
+        "running_tasks",
+        "started_at",
+        "idle_since",
+        "dedicated",
+        "has_tpu",
+    )
+
+    def __init__(
+        self, worker_id: bytes, node_id: bytes, conn: Connection, pid: int, has_tpu: bool = False
+    ):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn = conn
+        self.pid = pid
+        self.idle = True
+        self.actor_id: Optional[bytes] = None
+        self.running_tasks: Set[bytes] = set()
+        self.started_at = time.time()
+        self.idle_since = time.time()
+        self.dedicated = False  # actor-dedicated workers never return to pool
+        self.has_tpu = has_tpu  # spawned with the TPU claim env intact
+
+
+class NodeInfo:
+    __slots__ = (
+        "node_id",
+        "conn",
+        "resources_total",
+        "resources_available",
+        "store_path",
+        "alive",
+        "workers",
+        "starting_workers",
+        "labels",
+        "address",
+    )
+
+    def __init__(self, node_id: bytes, conn: Optional[Connection], resources: Dict[str, float], store_path: str):
+        self.node_id = node_id
+        self.conn = conn  # raylet connection (None for the head's own node)
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.store_path = store_path
+        self.alive = True
+        self.workers: Dict[bytes, WorkerInfo] = {}
+        self.starting_workers = 0
+        self.labels: Dict[str, str] = {}
+        self.address = ""
+
+    def can_fit(self, demand: Dict[str, float]) -> bool:
+        for k, v in demand.items():
+            if v > 0 and self.resources_available.get(k, 0.0) + 1e-9 < v:
+                return False
+        return True
+
+    def total_fit(self, demand: Dict[str, float]) -> bool:
+        for k, v in demand.items():
+            if v > 0 and self.resources_total.get(k, 0.0) + 1e-9 < v:
+                return False
+        return True
+
+    def acquire(self, demand: Dict[str, float]):
+        for k, v in demand.items():
+            if v > 0:
+                self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+
+    def release(self, demand: Dict[str, float]):
+        for k, v in demand.items():
+            if v > 0:
+                self.resources_available[k] = min(
+                    self.resources_available.get(k, 0.0) + v,
+                    self.resources_total.get(k, 0.0),
+                )
+
+    def utilization(self) -> float:
+        """Max over resources of used/total — the hybrid policy score input
+        (reference: scheduling/policy/hybrid_scheduling_policy.cc)."""
+        u = 0.0
+        for k, tot in self.resources_total.items():
+            if tot > 0:
+                used = tot - self.resources_available.get(k, 0.0)
+                u = max(u, used / tot)
+        return u
+
+
+class ActorInfo:
+    __slots__ = (
+        "actor_id",
+        "state",
+        "worker_id",
+        "node_id",
+        "creation_spec",
+        "name",
+        "namespace",
+        "detached",
+        "max_restarts",
+        "restarts_used",
+        "pending_calls",
+        "death_cause",
+        "owner_conn_id",
+    )
+
+    def __init__(self, spec: TaskSpec):
+        self.actor_id = spec.actor_id
+        self.state = ACTOR_PENDING
+        self.worker_id: Optional[bytes] = None
+        self.node_id: Optional[bytes] = None
+        self.creation_spec = spec
+        self.name = spec.name
+        self.namespace = spec.namespace
+        self.detached = spec.detached
+        self.max_restarts = spec.max_restarts
+        self.restarts_used = 0
+        self.pending_calls: List[TaskSpec] = []
+        self.death_cause = ""
+        self.owner_conn_id: Optional[int] = None
+
+
+class PlacementGroupInfo:
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "bundle_nodes", "waiters", "bundle_available")
+
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]], strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"
+        self.bundle_nodes: List[Optional[bytes]] = [None] * len(bundles)
+        # per-bundle remaining resources (consumed by tasks placed in it)
+        self.bundle_available: List[Dict[str, float]] = [dict(b) for b in bundles]
+        self.waiters: List[asyncio.Future] = []
+
+
+class TaskEntry:
+    """A task known to the scheduler: queued, leased, or running."""
+
+    __slots__ = ("spec", "state", "worker_id", "node_id", "caller_conn_id", "blocked")
+
+    def __init__(self, spec: TaskSpec, caller_conn_id: int):
+        self.spec = spec
+        self.state = "QUEUED"
+        self.worker_id: Optional[bytes] = None
+        self.node_id: Optional[bytes] = None
+        self.caller_conn_id = caller_conn_id
+        self.blocked = False  # worker released cpu while waiting in get()
+
+
+class HeadServer:
+    """The cluster brain.  One instance per cluster."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        store_path: str = "",
+        store_capacity: int = 0,
+        session_dir: str = "",
+    ):
+        self.host = host
+        self.port = port
+        self.session_dir = session_dir or "/tmp/ray_tpu"
+        self.store_path = store_path or os.path.join(self.session_dir, "store")
+        self.store_capacity = store_capacity or RayConfig.object_store_memory
+        self._server: Optional[asyncio.AbstractServer] = None
+
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        self.head_node_id = NodeID.from_random().binary()
+        self._head_resources = resources or {}
+
+        self.workers: Dict[bytes, WorkerInfo] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.pgs: Dict[bytes, PlacementGroupInfo] = {}
+        self.jobs: Dict[bytes, dict] = {}
+
+        # object directory: oid -> [state, error_payload]
+        self.objects: Dict[bytes, List] = {}
+        self.object_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self.object_refcounts: Dict[bytes, int] = {}
+
+        self.kv: Dict[str, bytes] = {}
+        # pubsub: channel -> {conn_id: Connection}
+        self.subscribers: Dict[str, Dict[int, Connection]] = {}
+
+        self.task_queue: List[TaskEntry] = []
+        self.tasks: Dict[bytes, TaskEntry] = {}  # leased/running by task id
+        self.finished_task_count = 0
+
+        self._conn_seq = 0
+        self._conns: Dict[int, Connection] = {}
+        self._conn_kind: Dict[int, str] = {}  # driver|worker|raylet
+        self._conn_worker: Dict[int, bytes] = {}
+        self._conn_node: Dict[int, bytes] = {}
+        self._sched_wakeup = asyncio.Event()
+        self._shutdown = False
+        self._worker_env: Dict[str, str] = {}
+        self._next_worker_seq = 0
+
+    # ------------------------------------------------------------------ setup
+
+    async def start(self) -> int:
+        os.makedirs(self.session_dir, exist_ok=True)
+        # head's own node
+        res = dict(self._head_resources)
+        res.setdefault("CPU", float(os.cpu_count() or 4))
+        res.setdefault("memory", 4.0 * (1 << 30))
+        res.setdefault("object_store_memory", float(self.store_capacity))
+        node = NodeInfo(self.head_node_id, None, res, self.store_path)
+        node.labels["node_type"] = "head"
+        self.nodes[self.head_node_id] = node
+        # create the shm store segment for the head node
+        from ray_tpu.core.shm_store import ShmObjectStore
+
+        self._store = ShmObjectStore(self.store_path, capacity=self.store_capacity, create=True)
+
+        self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        asyncio.get_running_loop().create_task(self._scheduler_loop())
+        asyncio.get_running_loop().create_task(self._idle_reaper_loop())
+        logger.info("head server listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        self._shutdown = True
+        # kill all worker processes we know about
+        for w in list(self.workers.values()):
+            try:
+                os.kill(w.pid, 15)
+            except OSError:
+                pass
+        for conn in list(self._conns.values()):
+            conn.close()
+        if self._server:
+            self._server.close()
+        try:
+            self._store.close()
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- connections
+
+    async def _on_connection(self, reader, writer):
+        conn = Connection(reader, writer)
+        self._conn_seq += 1
+        cid = self._conn_seq
+        self._conns[cid] = conn
+        try:
+            while not self._shutdown:
+                msg_type, rid, payload = await conn.read_frame()
+                if conn.dispatch_reply(msg_type, rid, payload):
+                    continue
+                # serve each request concurrently; handler errors reply ERROR
+                asyncio.get_running_loop().create_task(
+                    self._handle(cid, conn, msg_type, rid, payload)
+                )
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._conns.pop(cid, None)
+            conn.close()
+            await self._on_disconnect(cid)
+
+    async def _handle(self, cid: int, conn: Connection, msg_type: int, rid: int, payload: dict):
+        try:
+            handler = self._HANDLERS.get(msg_type)
+            if handler is None:
+                raise ValueError(f"unknown message type {msg_type}")
+            result = await handler(self, cid, conn, payload)
+            if rid:
+                await conn.reply(rid, result or {})
+        except Exception as e:  # noqa: BLE001
+            logger.exception("handler error for msg %s", msg_type)
+            if rid:
+                try:
+                    await conn.reply(rid, {}, error=f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
+    async def _on_disconnect(self, cid: int):
+        kind = self._conn_kind.pop(cid, None)
+        if kind == "worker":
+            wid = self._conn_worker.pop(cid, None)
+            if wid:
+                await self._on_worker_dead(wid, "worker process died (connection lost)")
+        elif kind == "raylet":
+            nid = self._conn_node.pop(cid, None)
+            if nid:
+                await self._on_node_dead(nid)
+        elif kind == "driver":
+            # non-detached actors owned by this driver die with it
+            for actor in list(self.actors.values()):
+                if actor.owner_conn_id == cid and not actor.detached:
+                    await self._destroy_actor(actor, "owner driver exited")
+
+    # ------------------------------------------------------ lifecycle: nodes
+
+    async def h_register_node(self, cid, conn, p):
+        nid = p["node_id"]
+        node = NodeInfo(nid, conn, p["resources"], p["store_path"])
+        node.address = p.get("address", "")
+        self.nodes[nid] = node
+        self._conn_kind[cid] = "raylet"
+        self._conn_node[cid] = nid
+        self._kick_scheduler()
+        return {"ok": True, "head_node_id": self.head_node_id}
+
+    async def h_register_worker(self, cid, conn, p):
+        wid = p["worker_id"]
+        nid = p["node_id"]
+        node = self.nodes.get(nid)
+        if node is None:
+            raise ValueError("unknown node")
+        w = WorkerInfo(wid, nid, conn, p["pid"], has_tpu=bool(p.get("has_tpu")))
+        self.workers[wid] = w
+        node.workers[wid] = w
+        node.starting_workers = max(0, node.starting_workers - 1)
+        self._conn_kind[cid] = "worker"
+        self._conn_worker[cid] = wid
+        self._kick_scheduler()
+        return {"ok": True, "store_path": node.store_path}
+
+    async def h_register_driver(self, cid, conn, p):
+        self._conn_kind[cid] = "driver"
+        job_id = p.get("job_id", b"")
+        self.jobs[job_id] = {"started_at": time.time(), "driver_pid": p.get("pid", 0)}
+        self._worker_env.update(p.get("worker_env") or {})
+        return {
+            "ok": True,
+            "store_path": self.nodes[self.head_node_id].store_path,
+            "node_id": self.head_node_id,
+        }
+
+    async def h_heartbeat(self, cid, conn, p):
+        return {"ok": True, "t": time.time()}
+
+    async def _on_node_dead(self, nid: bytes):
+        node = self.nodes.get(nid)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s died", nid.hex()[:8])
+        for wid in list(node.workers):
+            await self._on_worker_dead(wid, "node died")
+        # strip PG bundles on the dead node
+        for pg in self.pgs.values():
+            for i, bn in enumerate(pg.bundle_nodes):
+                if bn == nid:
+                    pg.bundle_nodes[i] = None
+                    pg.state = "RESCHEDULING"
+        del self.nodes[nid]
+        await self._publish("node", {"event": "dead", "node_id": nid})
+        self._kick_scheduler()
+
+    # ---------------------------------------------------- lifecycle: workers
+
+    async def _on_worker_dead(self, wid: bytes, reason: str):
+        w = self.workers.pop(wid, None)
+        if w is None:
+            return
+        node = self.nodes.get(w.node_id)
+        if node:
+            node.workers.pop(wid, None)
+        logger.info("worker %s dead: %s", wid.hex()[:8], reason)
+        # fail or retry its running tasks
+        for tid in list(w.running_tasks):
+            entry = self.tasks.pop(tid, None)
+            if entry is None:
+                continue
+            # only normal tasks hold node resources while running; actor
+            # method calls run on the actor's lifetime reservation
+            if (
+                node
+                and entry.state == "RUNNING"
+                and not entry.blocked
+                and entry.spec.task_type == NORMAL_TASK
+            ):
+                self._release_task_resources(node, entry.spec)
+            if entry.spec.task_type == ACTOR_CREATION_TASK:
+                continue  # actor FSM handles it below
+            if entry.spec.retries_left > 0:
+                entry.spec.retries_left -= 1
+                entry.state = "QUEUED"
+                entry.worker_id = None
+                self.tasks[tid] = entry  # stays tracked across the retry
+                self.task_queue.append(entry)
+                logger.info("retrying task %s (%d retries left)", entry.spec.function_name, entry.spec.retries_left)
+            else:
+                self._unpin_args(entry.spec)
+                await self._seal_error_objects(
+                    entry.spec,
+                    f"WorkerCrashedError: worker died while running "
+                    f"{entry.spec.function_name or entry.spec.method_name}: {reason}",
+                )
+        # actor hosted on this worker?
+        if w.actor_id is not None:
+            actor = self.actors.get(w.actor_id)
+            if actor is not None:
+                await self._on_actor_worker_dead(actor, reason)
+        self._kick_scheduler()
+
+    async def _on_actor_worker_dead(self, actor: ActorInfo, reason: str):
+        if actor.state == ACTOR_DEAD:
+            return
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node:
+            node.release(self._actor_lifetime_resources(actor.creation_spec))
+        actor.worker_id = None
+        actor.node_id = None
+        if actor.restarts_used < actor.max_restarts or actor.max_restarts == -1:
+            actor.restarts_used += 1
+            actor.state = ACTOR_RESTARTING
+            spec = actor.creation_spec
+            entry = TaskEntry(spec, -1)
+            self.tasks[spec.task_id] = entry
+            self.task_queue.append(entry)
+            logger.info(
+                "restarting actor %s (%d/%s)",
+                actor.actor_id.hex()[:8],
+                actor.restarts_used,
+                actor.max_restarts,
+            )
+            await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_RESTARTING})
+        else:
+            await self._destroy_actor(actor, reason)
+        self._kick_scheduler()
+
+    async def _destroy_actor(self, actor: ActorInfo, reason: str):
+        if actor.state == ACTOR_DEAD:
+            return
+        actor.state = ACTOR_DEAD
+        actor.death_cause = reason
+        if actor.name:
+            self.named_actors.pop((actor.namespace, actor.name), None)
+        # fail queued calls
+        for spec in actor.pending_calls:
+            self._unpin_args(spec)
+            await self._seal_error_objects(spec, f"RayActorError: {reason}")
+        actor.pending_calls.clear()
+        # drop queued creation / calls in the scheduler queue
+        self.task_queue = [
+            e
+            for e in self.task_queue
+            if not (e.spec.actor_id == actor.actor_id)
+        ]
+        if actor.worker_id:
+            w = self.workers.get(actor.worker_id)
+            if w is not None:
+                w.actor_id = None
+                try:
+                    os.kill(w.pid, 15)
+                except OSError:
+                    pass
+            node = self.nodes.get(actor.node_id) if actor.node_id else None
+            if node:
+                node.release(self._actor_lifetime_resources(actor.creation_spec))
+            actor.worker_id = None
+        await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_DEAD, "reason": reason})
+
+    # --------------------------------------------------------------- objects
+
+    def _object_entry(self, oid: bytes) -> List:
+        e = self.objects.get(oid)
+        if e is None:
+            e = [PENDING, None]
+            self.objects[oid] = e
+        return e
+
+    async def _seal_object(self, oid: bytes):
+        e = self._object_entry(oid)
+        e[0] = SEALED
+        for fut in self.object_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(e)
+
+    async def _seal_error_objects(self, spec: TaskSpec, error: str):
+        """Mark every return object of a failed task as errored; waiters get
+        the error string and raise client-side."""
+        for oid in spec.return_object_ids():
+            e = self._object_entry(oid)
+            e[0] = ERRORED
+            e[1] = error
+            for fut in self.object_waiters.pop(oid, []):
+                if not fut.done():
+                    fut.set_result(e)
+
+    async def h_put_object(self, cid, conn, p):
+        await self._seal_object(p["object_id"])
+        return {"ok": True}
+
+    async def h_wait_object(self, cid, conn, p):
+        if "object_ids" in p:
+            return await self._wait_batch(p)
+        oid = p["object_id"]
+        timeout = p.get("timeout")
+        e = self._object_entry(oid)
+        if e[0] == PENDING:
+            fut = asyncio.get_running_loop().create_future()
+            self.object_waiters.setdefault(oid, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return {"state": "timeout"}
+        e = self.objects[oid]
+        if e[0] == ERRORED:
+            return {"state": "error", "error": e[1]}
+        return {"state": "sealed"}
+
+    async def _wait_batch(self, p):
+        """Server-side ray.wait: block until num_ready of the ids are
+        sealed/errored or the timeout passes (analog: reference
+        WaitManager, src/ray/raylet/wait_manager.cc)."""
+        oids = [bytes(o) for o in p["object_ids"]]
+        want = min(p.get("num_ready", len(oids)), len(oids))
+        timeout = p.get("timeout")
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            ready = [o for o in oids if self._object_entry(o)[0] != PENDING]
+            if len(ready) >= want or (deadline is not None and time.time() >= deadline):
+                return {"ready": ready}
+            futs = []
+            for o in oids:
+                e = self._object_entry(o)
+                if e[0] == PENDING:
+                    f = asyncio.get_running_loop().create_future()
+                    self.object_waiters.setdefault(o, []).append(f)
+                    futs.append(f)
+            rem = None if deadline is None else max(0.001, deadline - time.time())
+            done, pending = await asyncio.wait(
+                futs, timeout=rem, return_when=asyncio.FIRST_COMPLETED
+            )
+            for f in pending:
+                f.cancel()
+
+    async def h_free_object(self, cid, conn, p):
+        for oid in p["object_ids"]:
+            self.objects.pop(oid, None)
+            self._store.delete(oid)
+        return {"ok": True}
+
+    async def h_add_ref(self, cid, conn, p):
+        for oid in p["object_ids"]:
+            self.object_refcounts[oid] = self.object_refcounts.get(oid, 0) + 1
+        return {"ok": True}
+
+    def _unpin_args(self, spec: TaskSpec):
+        """Release the submit-time pins on ARG_REF arguments (paired with
+        the bump in h_submit_task)."""
+        for arg in spec.args:
+            if arg[0] == 1:  # ARG_REF
+                self._dec_ref(bytes(arg[2]))
+
+    def _dec_ref(self, oid: bytes):
+        n = self.object_refcounts.get(oid, 0) - 1
+        if n <= 0:
+            self.object_refcounts.pop(oid, None)
+            # out of scope everywhere → evictable; delete eagerly
+            self.objects.pop(oid, None)
+            self._store.delete(oid)
+        else:
+            self.object_refcounts[oid] = n
+
+    async def h_remove_ref(self, cid, conn, p):
+        for oid in p["object_ids"]:
+            self._dec_ref(oid)
+        return {"ok": True}
+
+    # ----------------------------------------------------------------- tasks
+
+    async def h_submit_task(self, cid, conn, p):
+        spec = TaskSpec.from_wire(p["spec"])
+        for oid in spec.return_object_ids():
+            self._object_entry(oid)
+        # pin ref-args until the task completes so an eager driver-side
+        # del doesn't free an argument out from under the task
+        for arg in spec.args:
+            if arg[0] == 1:  # ARG_REF
+                oid = bytes(arg[2])
+                self.object_refcounts[oid] = self.object_refcounts.get(oid, 0) + 1
+        if spec.task_type == ACTOR_TASK:
+            return await self._submit_actor_task(spec)
+        entry = TaskEntry(spec, cid)
+        self.tasks[spec.task_id] = entry
+        self.task_queue.append(entry)
+        self._kick_scheduler()
+        return {"ok": True}
+
+    async def _submit_actor_task(self, spec: TaskSpec):
+        actor = self.actors.get(spec.actor_id)
+        if actor is None:
+            self._unpin_args(spec)
+            await self._seal_error_objects(spec, "RayActorError: unknown actor")
+            return {"ok": False}
+        if actor.state == ACTOR_DEAD:
+            self._unpin_args(spec)
+            await self._seal_error_objects(spec, f"RayActorError: {actor.death_cause or 'actor is dead'}")
+            return {"ok": False}
+        if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING) or actor.worker_id is None:
+            actor.pending_calls.append(spec)
+            return {"ok": True, "queued": True}
+        await self._push_actor_task(actor, spec)
+        return {"ok": True}
+
+    async def _push_actor_task(self, actor: ActorInfo, spec: TaskSpec):
+        w = self.workers.get(actor.worker_id)
+        if w is None:
+            actor.pending_calls.append(spec)
+            return
+        entry = TaskEntry(spec, -1)
+        entry.state = "RUNNING"
+        entry.worker_id = w.worker_id
+        entry.node_id = w.node_id
+        self.tasks[spec.task_id] = entry
+        w.running_tasks.add(spec.task_id)
+        await w.conn.send(MsgType.PUSH_TASK, {"spec": spec.to_wire()})
+
+    async def h_task_done(self, cid, conn, p):
+        tid = p["task_id"]
+        entry = self.tasks.pop(tid, None)
+        wid = self._conn_worker.get(cid)
+        w = self.workers.get(wid) if wid else None
+        if w is not None:
+            w.running_tasks.discard(tid)
+        self.finished_task_count += 1
+        if entry is not None:
+            self._unpin_args(entry.spec)
+            spec = entry.spec
+            node = self.nodes.get(entry.node_id) if entry.node_id else None
+            if spec.task_type == NORMAL_TASK:
+                if node and not entry.blocked:
+                    self._release_task_resources(node, spec)
+                if w is not None and not w.dedicated:
+                    w.idle = True
+                    w.idle_since = time.time()
+            if p.get("error") and spec.task_type == ACTOR_CREATION_TASK:
+                actor = self.actors.get(spec.actor_id)
+                if actor:
+                    await self._destroy_actor(actor, f"creation failed: {p['error']}")
+            elif spec.task_type == ACTOR_CREATION_TASK:
+                actor = self.actors.get(spec.actor_id)
+                if actor:
+                    actor.state = ACTOR_ALIVE
+                    await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_ALIVE})
+                    # flush queued calls in order
+                    calls, actor.pending_calls = actor.pending_calls, []
+                    for call in calls:
+                        await self._push_actor_task(actor, call)
+        # seal return objects (worker stored them before TASK_DONE).  When the
+        # task raised, the worker stores the RayTaskError *as the value* and
+        # sets stored_error — the directory seals normally and the client
+        # raises on deserialize (reference semantics).
+        if p.get("error") and not p.get("stored_error"):
+            if entry is not None:
+                await self._seal_error_objects(entry.spec, p["error"])
+        else:
+            for oid in p.get("sealed", []):
+                await self._seal_object(oid)
+        self._kick_scheduler()
+        return {"ok": True}
+
+    async def h_task_blocked(self, cid, conn, p):
+        """Worker blocked in get(): release its cpu so dependents can run
+        (analog: reference NotifyDirectCallTaskBlocked → raylet releases the
+        lease's cpu, node_manager.cc HandleNotifyDirectCallTaskBlocked)."""
+        entry = self.tasks.get(p["task_id"])
+        if entry and not entry.blocked and entry.spec.task_type == NORMAL_TASK and entry.node_id:
+            node = self.nodes.get(entry.node_id)
+            if node:
+                entry.blocked = True
+                self._release_task_resources(node, entry.spec)
+                self._kick_scheduler()
+        return {}
+
+    async def h_task_unblocked(self, cid, conn, p):
+        entry = self.tasks.get(p["task_id"])
+        if entry and entry.blocked and entry.node_id:
+            node = self.nodes.get(entry.node_id)
+            if node:
+                entry.blocked = False
+                # reacquire; transient oversubscription is allowed, as in the
+                # reference (the worker already holds the lease)
+                node.acquire(self._task_resources(entry.spec))
+        return {}
+
+    async def h_cancel_task(self, cid, conn, p):
+        tid = p["task_id"]
+        for e in self.task_queue:
+            if e.spec.task_id == tid:
+                self.task_queue.remove(e)
+                self.tasks.pop(tid, None)
+                self._unpin_args(e.spec)
+                await self._seal_error_objects(e.spec, "TaskCancelledError: cancelled before execution")
+                return {"ok": True, "cancelled": True}
+        entry = self.tasks.get(tid)
+        if entry is not None and entry.worker_id:
+            w = self.workers.get(entry.worker_id)
+            if w is not None:
+                await w.conn.send(MsgType.CANCEL_TASK, {"task_id": tid})
+                if p.get("force"):
+                    try:
+                        os.kill(w.pid, 9)
+                    except OSError:
+                        pass
+        return {"ok": True, "cancelled": False}
+
+    # ---------------------------------------------------------------- actors
+
+    async def h_create_actor(self, cid, conn, p):
+        spec = TaskSpec.from_wire(p["spec"])
+        if spec.name:
+            key = (spec.namespace, spec.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != ACTOR_DEAD:
+                    raise ValueError(f"actor name {spec.name!r} already taken")
+        actor = ActorInfo(spec)
+        actor.owner_conn_id = cid
+        self.actors[spec.actor_id] = actor
+        if spec.name:
+            self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
+        for oid in spec.return_object_ids():
+            self._object_entry(oid)
+        entry = TaskEntry(spec, cid)
+        self.tasks[spec.task_id] = entry
+        self.task_queue.append(entry)
+        self._kick_scheduler()
+        return {"ok": True}
+
+    async def h_get_actor(self, cid, conn, p):
+        name, namespace = p.get("name", ""), p.get("namespace", "")
+        aid = p.get("actor_id") or self.named_actors.get((namespace, name))
+        if aid is None or aid not in self.actors:
+            return {"found": False}
+        a = self.actors[aid]
+        return {
+            "found": a.state != ACTOR_DEAD,
+            "actor_id": a.actor_id,
+            "state": a.state,
+            "creation_spec": a.creation_spec.to_wire(),
+        }
+
+    async def h_kill_actor(self, cid, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if p.get("no_restart", True):
+            actor.max_restarts = actor.restarts_used  # forbid further restarts
+            await self._destroy_actor(actor, "ray.kill")
+        else:
+            if actor.worker_id:
+                w = self.workers.get(actor.worker_id)
+                if w:
+                    try:
+                        os.kill(w.pid, 9)
+                    except OSError:
+                        pass
+        return {"ok": True}
+
+    async def h_actor_state(self, cid, conn, p):
+        a = self.actors.get(p["actor_id"])
+        if a is None:
+            return {"state": "UNKNOWN"}
+        return {"state": a.state, "death_cause": a.death_cause}
+
+    async def h_list_actors(self, cid, conn, p):
+        out = []
+        for a in self.actors.values():
+            out.append(
+                {
+                    "actor_id": a.actor_id,
+                    "state": a.state,
+                    "name": a.name,
+                    "namespace": a.namespace,
+                    "class_name": a.creation_spec.function_name,
+                    "node_id": a.node_id or b"",
+                    "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else 0,
+                }
+            )
+        return {"actors": out}
+
+    # ------------------------------------------------------ placement groups
+
+    async def h_create_pg(self, cid, conn, p):
+        pg = PlacementGroupInfo(p["pg_id"], p["bundles"], p["strategy"], p.get("name", ""))
+        self.pgs[pg.pg_id] = pg
+        self._try_place_pg(pg)
+        self._kick_scheduler()
+        return {"ok": True, "placed": pg.state == "CREATED"}
+
+    def _try_place_pg(self, pg: PlacementGroupInfo) -> bool:
+        """All-or-nothing bundle placement (2-phase reserve in the reference:
+        gcs_placement_group_scheduler.cc PrepareResources/CommitResources —
+        atomic here because the resource view is centralized)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        placement: List[Tuple[int, NodeInfo]] = []
+        # simulate against copies of available resources
+        sim = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(node, bundle):
+            av = sim[node.node_id]
+            return all(av.get(k, 0.0) + 1e-9 >= v for k, v in bundle.items() if v > 0)
+
+        def take(node, bundle):
+            av = sim[node.node_id]
+            for k, v in bundle.items():
+                if v > 0:
+                    av[k] = av.get(k, 0.0) - v
+
+        strategy = pg.strategy
+        if strategy == "STRICT_PACK":
+            for n in alive:
+                ok = True
+                snapshot = dict(sim[n.node_id])
+                for b in pg.bundles:
+                    if fits(n, b):
+                        take(n, b)
+                    else:
+                        ok = False
+                        break
+                if not ok:
+                    sim[n.node_id] = snapshot
+                    continue
+                placement = [(i, n) for i in range(len(pg.bundles))]
+                break
+            if not placement:
+                return False
+        elif strategy == "STRICT_SPREAD":
+            if len(alive) < len(pg.bundles):
+                return False
+            used_nodes: Set[bytes] = set()
+            for i, b in enumerate(pg.bundles):
+                cand = [n for n in alive if n.node_id not in used_nodes and fits(n, b)]
+                if not cand:
+                    return False
+                n = max(cand, key=lambda x: x.resources_available.get("CPU", 0))
+                take(n, b)
+                used_nodes.add(n.node_id)
+                placement.append((i, n))
+        elif strategy == "SPREAD":
+            last = None
+            for i, b in enumerate(pg.bundles):
+                cand = [n for n in alive if fits(n, b)]
+                if not cand:
+                    return False
+                cand.sort(key=lambda x: (x.node_id == (last or b""), -x.resources_available.get("CPU", 0)))
+                n = cand[0]
+                take(n, b)
+                last = n.node_id
+                placement.append((i, n))
+        else:  # PACK (default): prefer one node, fall back to others
+            for i, b in enumerate(pg.bundles):
+                cand = [n for n in alive if fits(n, b)]
+                if not cand:
+                    return False
+                cand.sort(key=lambda x: -x.utilization())
+                n = cand[0]
+                take(n, b)
+                placement.append((i, n))
+        # commit
+        for i, n in placement:
+            n.acquire(pg.bundles[i])
+            pg.bundle_nodes[i] = n.node_id
+        pg.state = "CREATED"
+        pg.bundle_available = [dict(b) for b in pg.bundles]
+        for fut in pg.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        pg.waiters.clear()
+        return True
+
+    async def h_pg_ready(self, cid, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            raise ValueError("unknown placement group")
+        if pg.state == "CREATED":
+            return {"ready": True}
+        fut = asyncio.get_running_loop().create_future()
+        pg.waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, p.get("timeout"))
+            return {"ready": True}
+        except asyncio.TimeoutError:
+            return {"ready": False}
+
+    async def h_remove_pg(self, cid, conn, p):
+        pg = self.pgs.pop(p["pg_id"], None)
+        if pg is None:
+            return {"ok": False}
+        if pg.state == "CREATED":
+            for i, nid in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(nid) if nid else None
+                if node:
+                    # release what the PG still holds (reserved minus consumed is
+                    # held by running tasks; they release into the node on finish)
+                    node.release(pg.bundle_available[i])
+        pg.state = "REMOVED"
+        return {"ok": True}
+
+    async def h_get_pg(self, cid, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {
+            "found": True,
+            "state": pg.state,
+            "bundles": pg.bundles,
+            "strategy": pg.strategy,
+            "bundle_nodes": [n or b"" for n in pg.bundle_nodes],
+        }
+
+    async def h_list_pgs(self, cid, conn, p):
+        return {
+            "pgs": [
+                {"pg_id": pg.pg_id, "name": pg.name, "state": pg.state, "strategy": pg.strategy}
+                for pg in self.pgs.values()
+            ]
+        }
+
+    # ------------------------------------------------------------- KV/pubsub
+
+    async def h_kv_put(self, cid, conn, p):
+        key = p["key"]
+        if p.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = p["value"]
+            await self._publish(f"kv:{key}", {"key": key, "value": p["value"]})
+            return {"added": True}
+        return {"added": False}
+
+    async def h_kv_get(self, cid, conn, p):
+        if p.get("wait"):
+            deadline = time.time() + (p.get("timeout") or RayConfig.collective_rendezvous_timeout_s)
+            while p["key"] not in self.kv:
+                if time.time() > deadline:
+                    return {"found": False}
+                await asyncio.sleep(0.01)
+        v = self.kv.get(p["key"])
+        return {"found": v is not None, "value": v if v is not None else b""}
+
+    async def h_kv_del(self, cid, conn, p):
+        n = 0
+        if p.get("prefix"):
+            for k in [k for k in self.kv if k.startswith(p["key"])]:
+                del self.kv[k]
+                n += 1
+        elif p["key"] in self.kv:
+            del self.kv[p["key"]]
+            n = 1
+        return {"deleted": n}
+
+    async def h_kv_keys(self, cid, conn, p):
+        pref = p.get("prefix", "")
+        return {"keys": [k for k in self.kv if k.startswith(pref)]}
+
+    async def h_kv_exists(self, cid, conn, p):
+        return {"exists": p["key"] in self.kv}
+
+    async def h_subscribe(self, cid, conn, p):
+        self.subscribers.setdefault(p["channel"], {})[cid] = conn
+        return {"ok": True}
+
+    async def h_publish(self, cid, conn, p):
+        await self._publish(p["channel"], p["message"])
+        return {"ok": True}
+
+    async def _publish(self, channel: str, message: dict):
+        subs = self.subscribers.get(channel)
+        if not subs:
+            return
+        dead = []
+        for cid, conn in subs.items():
+            try:
+                await conn.send(MsgType.PUBLISH, {"channel": channel, "message": message})
+            except Exception:
+                dead.append(cid)
+        for cid in dead:
+            subs.pop(cid, None)
+
+    # -------------------------------------------------------- cluster state
+
+    async def h_cluster_resources(self, cid, conn, p):
+        total: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources_total.items():
+                    total[k] = total.get(k, 0.0) + v
+        return {"resources": total}
+
+    async def h_available_resources(self, cid, conn, p):
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources_available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+        return {"resources": avail}
+
+    async def h_list_nodes(self, cid, conn, p):
+        return {
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "resources": n.resources_total,
+                    "available": n.resources_available,
+                    "labels": n.labels,
+                    "num_workers": len(n.workers),
+                }
+                for n in self.nodes.values()
+            ]
+        }
+
+    async def h_list_tasks(self, cid, conn, p):
+        out = []
+        for e in self.task_queue:
+            out.append({"task_id": e.spec.task_id, "state": "QUEUED", "name": e.spec.function_name})
+        for e in self.tasks.values():
+            if e.state != "QUEUED":
+                out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
+        return {"tasks": out, "finished": self.finished_task_count}
+
+    async def h_drain_node(self, cid, conn, p):
+        nid = p["node_id"]
+        await self._on_node_dead(nid)
+        return {"ok": True}
+
+
+    # -------------------------------------------------------------- scheduler
+
+    def _kick_scheduler(self):
+        self._sched_wakeup.set()
+
+    def _task_resources(self, spec: TaskSpec) -> Dict[str, float]:
+        return spec.resources or {"CPU": 1.0}
+
+    def _actor_lifetime_resources(self, spec: TaskSpec) -> Dict[str, float]:
+        return spec.resources or {"CPU": 1.0}
+
+    def _release_task_resources(self, node: NodeInfo, spec: TaskSpec):
+        res = self._task_resources(spec)
+        if spec.pg_id and spec.pg_id in self.pgs:
+            pg = self.pgs[spec.pg_id]
+            idx = spec.pg_bundle_index if spec.pg_bundle_index >= 0 else 0
+            if idx < len(pg.bundle_available):
+                for k, v in res.items():
+                    if v > 0:
+                        pg.bundle_available[idx][k] = pg.bundle_available[idx].get(k, 0.0) + v
+        else:
+            node.release(res)
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[NodeInfo]:
+        """Hybrid scheduling policy (reference:
+        scheduling/policy/hybrid_scheduling_policy.h:48): pack onto the
+        best-utilized feasible node while utilization < threshold, else
+        spread to the least utilized."""
+        res = self._task_resources(spec)
+        if spec.pg_id:
+            pg = self.pgs.get(spec.pg_id)
+            if pg is None or pg.state != "CREATED":
+                return None
+            idx = spec.pg_bundle_index
+            candidates = range(len(pg.bundles)) if idx < 0 else [idx]
+            for i in candidates:
+                nid = pg.bundle_nodes[i]
+                node = self.nodes.get(nid) if nid else None
+                if node is None or not node.alive:
+                    continue
+                av = pg.bundle_available[i]
+                if all(av.get(k, 0.0) + 1e-9 >= v for k, v in res.items() if v > 0):
+                    # consume from the bundle, not the node pool
+                    for k, v in res.items():
+                        if v > 0:
+                            av[k] = av.get(k, 0.0) - v
+                    spec.pg_bundle_index = i
+                    return node
+            return None
+        if spec.node_affinity:
+            node = self.nodes.get(spec.node_affinity)
+            if node and node.alive and node.can_fit(res):
+                node.acquire(res)
+                return node
+            return None
+        feasible = [n for n in self.nodes.values() if n.alive and n.can_fit(res)]
+        if not feasible:
+            return None
+        thresh = RayConfig.scheduler_spread_threshold
+        packing = [n for n in feasible if n.utilization() < thresh]
+        if packing:
+            node = max(packing, key=lambda n: (n.utilization(), n.node_id == self.head_node_id))
+        else:
+            node = min(feasible, key=lambda n: n.utilization())
+        node.acquire(res)
+        return node
+
+    async def _scheduler_loop(self):
+        while not self._shutdown:
+            self._sched_wakeup.clear()
+            try:
+                await self._schedule_once()
+            except Exception:
+                logger.exception("scheduler tick failed")
+            try:
+                await asyncio.wait_for(self._sched_wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _schedule_once(self):
+        # retry pending PGs (e.g. after resources freed / node added)
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                self._try_place_pg(pg)
+        if not self.task_queue:
+            return
+        remaining: List[TaskEntry] = []
+        spawn_demand: Dict[bytes, int] = {}
+        # tasks that reserved resources but found no idle worker this tick;
+        # reservations are held until the end so demand is capped by what the
+        # node can actually run simultaneously (not by queue length)
+        unfulfilled: List[Tuple[TaskEntry, NodeInfo]] = []
+        for entry in self.task_queue:
+            spec = entry.spec
+            node = self._pick_node(spec)
+            if node is None:
+                # Infeasible tasks stay pending — a node with the resources
+                # may join later (reference semantics: raylet keeps
+                # infeasible tasks queued and warns; the autoscaler reacts).
+                remaining.append(entry)
+                continue
+            worker = self._find_idle_worker(node, spec)
+            if worker is None:
+                key = (node.node_id, self._needs_tpu(spec))
+                spawn_demand[key] = spawn_demand.get(key, 0) + 1
+                unfulfilled.append((entry, node))
+                remaining.append(entry)
+                continue
+            await self._dispatch(entry, node, worker)
+        for entry, node in unfulfilled:
+            self._release_task_resources(node, entry.spec)
+        self.task_queue = remaining
+        for (nid, tpu), demand in spawn_demand.items():
+            node = self.nodes.get(nid)
+            if node is not None:
+                self._maybe_spawn_worker(node, demand, tpu)
+
+    @staticmethod
+    def _needs_tpu(spec: TaskSpec) -> bool:
+        return (spec.resources or {}).get(RayConfig.tpu_slice_resource_name, 0) > 0
+
+    def _find_idle_worker(self, node: NodeInfo, spec: TaskSpec) -> Optional[WorkerInfo]:
+        needs_tpu = self._needs_tpu(spec)
+        for w in node.workers.values():
+            if w.idle and w.actor_id is None and not w.dedicated and w.has_tpu == needs_tpu:
+                return w
+        return None
+
+    def _maybe_spawn_worker(self, node: NodeInfo, demand: int = 1, tpu: bool = False):
+        """Spawn workers up to current demand — the startup-token discipline
+        of the reference's WorkerPool (worker_pool.cc:218
+        StartWorkerProcess + MonitorStartingWorkerProcess:485)."""
+        while node.starting_workers < demand:
+            pool_size = len(node.workers) + node.starting_workers
+            if pool_size >= RayConfig.worker_pool_max_workers:
+                return
+            node.starting_workers += 1
+            if node.conn is None:
+                self._spawn_local_worker(node, tpu)
+            else:
+                asyncio.get_running_loop().create_task(
+                    node.conn.send(MsgType.PUSH_TASK, {"directive": "spawn_worker", "tpu": tpu})
+                )
+
+    def _spawn_local_worker(self, node: NodeInfo, tpu: bool = False):
+        self._next_worker_seq += 1
+        env = dict(os.environ)
+        env.update(self._worker_env)
+        env["RAY_TPU_HEAD"] = f"{self.host}:{self.port}"
+        env["RAY_TPU_NODE_ID"] = node.node_id.hex()
+        env["RAY_TPU_STORE_PATH"] = node.store_path
+        if tpu:
+            # TPU worker: keep the ambient claim env (axon sitecustomize runs
+            # at interpreter start and needs it) — this worker owns the chips
+            env["RAY_TPU_WORKER_TPU"] = "1"
+            env.pop("JAX_PLATFORMS", None)
+        else:
+            # pool workers must not tunnel-claim the TPU at import
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("RAY_TPU_WORKER_TPU", None)
+        log = os.path.join(self.session_dir, f"worker-{self._next_worker_seq}.log")
+        with open(log, "ab") as logf:
+            subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=logf,
+                stderr=logf,
+                start_new_session=True,
+            )
+
+    async def _dispatch(self, entry: TaskEntry, node: NodeInfo, worker: WorkerInfo):
+        spec = entry.spec
+        entry.state = "RUNNING"
+        entry.worker_id = worker.worker_id
+        entry.node_id = node.node_id
+        worker.idle = False
+        worker.running_tasks.add(spec.task_id)
+        if spec.task_type == ACTOR_CREATION_TASK:
+            worker.dedicated = True
+            worker.actor_id = spec.actor_id
+            actor = self.actors.get(spec.actor_id)
+            if actor is not None:
+                actor.worker_id = worker.worker_id
+                actor.node_id = node.node_id
+        try:
+            await worker.conn.send(MsgType.PUSH_TASK, {"spec": spec.to_wire()})
+        except Exception:
+            await self._on_worker_dead(worker.worker_id, "push failed")
+
+    # ---------------------------------------------------------- maintenance
+
+    async def _idle_reaper_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(5.0)
+            now = time.time()
+            for node in self.nodes.values():
+                idle = [
+                    w
+                    for w in node.workers.values()
+                    if w.idle and not w.dedicated and now - w.idle_since > RayConfig.idle_worker_kill_s
+                ]
+                # keep a floor of warm workers
+                keep = RayConfig.worker_pool_min_idle
+                for w in idle[keep:]:
+                    try:
+                        os.kill(w.pid, 15)
+                    except OSError:
+                        pass
+
+    _HANDLERS = {}
+
+
+HeadServer._HANDLERS = {
+    MsgType.REGISTER_NODE: HeadServer.h_register_node,
+    MsgType.REGISTER_WORKER: HeadServer.h_register_worker,
+    MsgType.REGISTER_JOB: HeadServer.h_register_driver,
+    MsgType.HEARTBEAT: HeadServer.h_heartbeat,
+    MsgType.DRAIN_NODE: HeadServer.h_drain_node,
+    MsgType.SUBMIT_TASK: HeadServer.h_submit_task,
+    MsgType.TASK_DONE: HeadServer.h_task_done,
+    MsgType.CANCEL_TASK: HeadServer.h_cancel_task,
+    MsgType.TASK_BLOCKED: HeadServer.h_task_blocked,
+    MsgType.TASK_UNBLOCKED: HeadServer.h_task_unblocked,
+    MsgType.CREATE_ACTOR: HeadServer.h_create_actor,
+    MsgType.GET_ACTOR: HeadServer.h_get_actor,
+    MsgType.KILL_ACTOR: HeadServer.h_kill_actor,
+    MsgType.ACTOR_STATE: HeadServer.h_actor_state,
+    MsgType.LIST_ACTORS: HeadServer.h_list_actors,
+    MsgType.PUT_OBJECT: HeadServer.h_put_object,
+    MsgType.WAIT_OBJECT: HeadServer.h_wait_object,
+    MsgType.FREE_OBJECT: HeadServer.h_free_object,
+    MsgType.ADD_REF: HeadServer.h_add_ref,
+    MsgType.REMOVE_REF: HeadServer.h_remove_ref,
+    MsgType.KV_PUT: HeadServer.h_kv_put,
+    MsgType.KV_GET: HeadServer.h_kv_get,
+    MsgType.KV_DEL: HeadServer.h_kv_del,
+    MsgType.KV_KEYS: HeadServer.h_kv_keys,
+    MsgType.KV_EXISTS: HeadServer.h_kv_exists,
+    MsgType.SUBSCRIBE: HeadServer.h_subscribe,
+    MsgType.PUBLISH: HeadServer.h_publish,
+    MsgType.CREATE_PG: HeadServer.h_create_pg,
+    MsgType.REMOVE_PG: HeadServer.h_remove_pg,
+    MsgType.GET_PG: HeadServer.h_get_pg,
+    MsgType.PG_READY: HeadServer.h_pg_ready,
+    MsgType.LIST_PGS: HeadServer.h_list_pgs,
+    MsgType.CLUSTER_RESOURCES: HeadServer.h_cluster_resources,
+    MsgType.AVAILABLE_RESOURCES: HeadServer.h_available_resources,
+    MsgType.LIST_NODES: HeadServer.h_list_nodes,
+    MsgType.LIST_TASKS: HeadServer.h_list_tasks,
+}

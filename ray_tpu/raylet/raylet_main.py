@@ -1,0 +1,132 @@
+"""Raylet: the per-node agent.
+
+Analog of the reference's raylet binary (reference: src/ray/raylet/main.cc +
+worker_pool.cc): registers the node with the head, spawns worker processes
+on demand, and supervises them.  Scheduling decisions live in the head
+(see gcs/server.py); this agent is the node-local arm that executes
+spawn/kill directives — the WorkerPool half of the reference raylet.
+
+Round-1 simplification: nodes of one cluster share the head's shm store
+segment (all test "nodes" are processes on one machine, the same shape as
+the reference's cluster_utils harness, python/ray/cluster_utils.py:99).
+True multi-host adds the object-transfer layer (reference:
+src/ray/object_manager/) on top of this agent in a later round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.protocol import Connection, MsgType
+
+
+class Raylet:
+    def __init__(self, head_host: str, head_port: int, resources: dict, session_dir: str):
+        self.head_host = head_host
+        self.head_port = head_port
+        self.resources = resources
+        self.session_dir = session_dir
+        self.node_id = NodeID.from_random()
+        self.store_path = ""
+        self.worker_procs: List[subprocess.Popen] = []
+        self._worker_seq = 0
+
+    async def run(self):
+        conn = await Connection.connect(self.head_host, self.head_port)
+        self.conn = conn
+        # The head replies with its node's store path via REGISTER_JOB-style
+        # info; for now we register and receive ours from the head's reply.
+        reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
+        reply = await conn.request(
+            MsgType.REGISTER_NODE,
+            {
+                "node_id": self.node_id.binary(),
+                "resources": self.resources,
+                "store_path": self._head_store_path(),
+                "address": "127.0.0.1",
+            },
+        )
+        assert reply.get("ok")
+        print(f"NODE {self.node_id.hex()}", flush=True)
+        await reply_fut
+
+    def _head_store_path(self) -> str:
+        # shared-store simplification: all local nodes use the head's segment
+        return os.path.join(self.session_dir, "store")
+
+    async def _read_loop(self, conn: Connection):
+        try:
+            while True:
+                msg_type, rid, payload = await conn.read_frame()
+                if conn.dispatch_reply(msg_type, rid, payload):
+                    continue
+                if msg_type == MsgType.PUSH_TASK and payload.get("directive") == "spawn_worker":
+                    self._spawn_worker(tpu=bool(payload.get("tpu")))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.kill_workers()
+
+    def _spawn_worker(self, tpu: bool = False):
+        self._worker_seq += 1
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD"] = f"{self.head_host}:{self.head_port}"
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_STORE_PATH"] = self._head_store_path()
+        if tpu:
+            env["RAY_TPU_WORKER_TPU"] = "1"
+            env.pop("JAX_PLATFORMS", None)
+        else:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("RAY_TPU_WORKER_TPU", None)
+        log = os.path.join(
+            self.session_dir, f"worker-{self.node_id.hex()[:8]}-{self._worker_seq}.log"
+        )
+        with open(log, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=logf,
+                stderr=logf,
+            )
+        self.worker_procs.append(proc)
+
+    def kill_workers(self):
+        for proc in self.worker_procs:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)  # host:port
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--session-dir", required=True)
+    args = parser.parse_args()
+    host, port = args.head.rsplit(":", 1)
+    raylet = Raylet(host, int(port), json.loads(args.resources), args.session_dir)
+
+    def _term(signum, frame):
+        raylet.kill_workers()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        asyncio.run(raylet.run())
+    except KeyboardInterrupt:
+        raylet.kill_workers()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Public collective API.
+
+Keeps the reference's surface (reference: python/ray/util/collective/
+collective.py — init_collective_group:120, create_collective_group:151,
+allreduce:258, barrier:298, reduce:311, broadcast:373, allgather:423,
+reducescatter:472, send:531, recv:594) with TPU-native backends:
+
+- ``ici``: this process's jax devices, XLA collectives (ici_backend.py)
+- ``dcn``: cross-process TCP ring with head-KV rendezvous (dcn_backend.py)
+
+Rendezvous state lives in the head KV instead of a named store actor
+(reference used NCCLUniqueIDStore, collective_group/util.py:9).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import Backend, GroupInfo, ReduceOp
+
+
+class _KvShim:
+    """KV access that works inside any connected driver/worker process."""
+
+    def kv_put(self, key: str, value: bytes):
+        from ray_tpu._private import worker as worker_mod
+
+        worker_mod._require_connected().kv_put(key, value)
+
+    def kv_get(self, key: str, wait: bool = False, timeout: Optional[float] = None):
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod._require_connected().kv_get(key, wait=wait, timeout=timeout)
+
+
+class _GroupManager:
+    def __init__(self):
+        self._groups: Dict[str, object] = {}
+        self._infos: Dict[str, GroupInfo] = {}
+        self._lock = threading.Lock()
+
+    def create(self, backend: str, group_name: str, world_size: int, rank: int, devices=None):
+        backend = Backend.resolve(backend)
+        with self._lock:
+            if group_name in self._groups:
+                raise ValueError(f"collective group {group_name!r} already exists")
+        if backend == "ici":
+            from ray_tpu.util.collective.ici_backend import IciGroup
+
+            group = IciGroup(group_name, devices)
+            info = GroupInfo(group_name, group.world_size, 0, backend)
+        else:
+            from ray_tpu.util.collective.dcn_backend import DcnGroup
+
+            group = DcnGroup(group_name, world_size, rank, _KvShim())
+            info = GroupInfo(group_name, world_size, rank, backend)
+        with self._lock:
+            self._groups[group_name] = group
+            self._infos[group_name] = info
+        return group
+
+    def get(self, group_name: str):
+        g = self._groups.get(group_name)
+        if g is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this process; "
+                f"call init_collective_group() first"
+            )
+        return g
+
+    def info(self, group_name: str) -> GroupInfo:
+        return self._infos[group_name]
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(group_name, None)
+            self._infos.pop(group_name, None)
+        if g is not None:
+            g.destroy()
+
+
+_manager = _GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "dcn",
+    group_name: str = "default",
+    devices=None,
+):
+    """Called by each participant (usually inside a worker actor) to join a
+    group (reference: collective.py:120)."""
+    _manager.create(backend, group_name, world_size, rank, devices)
+
+
+def create_collective_group(
+    actors: List,
+    world_size: int,
+    ranks: List[int],
+    backend: str = "dcn",
+    group_name: str = "default",
+):
+    """Driver-side declaration: tells every actor to join (reference:
+    collective.py:151 — there it only *declares*; here we actively invoke
+    the actors' _ray_tpu_init_collective trampoline)."""
+    import ray_tpu
+    from ray_tpu.actor import ActorMethod
+
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        # ActorHandle.__getattr__ blocks underscore names; build the method
+        # explicitly — the worker-side executor special-cases this name
+        method = ActorMethod(actor, "_ray_tpu_init_collective")
+        refs.append(method.remote(world_size, rank, backend, group_name))
+    ray_tpu.get(refs, timeout=180)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.info(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.info(group_name).world_size
+
+
+def _to_numpy(tensor):
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    """In-place-style allreduce: returns the reduced tensor (numpy in/out
+    for dcn; jax arrays for ici)."""
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):  # dcn
+        return g.allreduce(_to_numpy(tensor), op)
+    return g.allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):  # dcn
+        return g.reduce(_to_numpy(tensor), dst_rank, op)
+    return g.reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):
+        return g.broadcast(_to_numpy(tensor), src_rank)
+    return g.broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):
+        return g.allgather(_to_numpy(tensor))
+    return g.allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):
+        return g.reducescatter(_to_numpy(tensor), op)
+    return g.reducescatter(tensor, op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _manager.get(group_name).send(_to_numpy(tensor), dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _manager.get(group_name).recv(src_rank)

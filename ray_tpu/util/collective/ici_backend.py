@@ -1,0 +1,119 @@
+"""ICI backend: collectives over the devices one process owns, via jax.
+
+The TPU pivot prescribed by SURVEY §2.4: where the reference wraps NCCL
+communicators per GPU (reference: python/ray/util/collective/
+collective_group/nccl_collective_group.py — allreduce:361 etc. over cupy
+NCCL), a TPU worker actor owns a whole host's chips and collectives run as
+jitted XLA ops over a 1-D device mesh — psum/all_gather/psum_scatter/
+ppermute ride the ICI fabric with zero Python in the loop.
+
+"rank" here is a *device* index within this process's group, matching the
+reference's *_multigpu variants (one process, several devices).  For
+cross-process groups use the DCN backend; for whole-pod SPMD use
+ray_tpu.parallel (mesh + pjit), which is the first-class path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_OP_TO_JAX = {
+    ReduceOp.SUM: "sum",
+    ReduceOp.PRODUCT: "prod",
+    ReduceOp.MIN: "min",
+    ReduceOp.MAX: "max",
+}
+
+
+class IciGroup:
+    """A collective group over this process's local jax devices."""
+
+    def __init__(self, group_name: str, devices: Optional[list] = None):
+        import jax
+
+        self.group_name = group_name
+        self.devices = devices if devices is not None else list(jax.devices())
+        self.world_size = len(self.devices)
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            self._mesh = Mesh(np.array(self.devices), axis_names=("ici",))
+        return self._mesh
+
+    @functools.lru_cache(maxsize=32)
+    def _allreduce_fn(self, op_name: str):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=NamedSharding(mesh, P("ici")),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+        def _reduce(stacked):
+            if op_name == "sum":
+                return stacked.sum(axis=0)
+            if op_name == "prod":
+                return stacked.prod(axis=0)
+            if op_name == "min":
+                return stacked.min(axis=0)
+            return stacked.max(axis=0)
+
+        return _reduce
+
+    def allreduce(self, per_device: List, op: ReduceOp = ReduceOp.SUM):
+        """Input: one array per device (the multigpu calling convention).
+        Output: the reduced array, replicated."""
+        import jax
+        import jax.numpy as jnp
+
+        stacked = jnp.stack([jnp.asarray(x) for x in per_device])
+        # shard the stacked leading axis across the group's devices so the
+        # reduction's cross-device traffic is an XLA all-reduce over ICI
+        result = self._allreduce_fn(_OP_TO_JAX[op])(stacked)
+        return [result] * self.world_size
+
+    def broadcast(self, per_device: List, src_rank: int = 0):
+        import jax
+
+        src = per_device[src_rank]
+        return [jax.device_put(src, d) for d in self.devices]
+
+    def allgather(self, per_device: List):
+        import jax.numpy as jnp
+
+        gathered = [jnp.asarray(x) for x in per_device]
+        return [list(gathered) for _ in range(self.world_size)]
+
+    def reducescatter(self, per_device: List, op: ReduceOp = ReduceOp.SUM):
+        import jax.numpy as jnp
+
+        reduced = self.allreduce(per_device, op)[0]
+        flat = reduced.reshape(-1)
+        splits = jnp.array_split(flat, self.world_size)
+        return [splits[i] for i in range(self.world_size)]
+
+    def reduce(self, per_device: List, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        reduced = self.allreduce(per_device, op)
+        # only dst holds the result; others keep their input (ref semantics)
+        return [reduced[i] if i == dst_rank else per_device[i] for i in range(self.world_size)]
+
+    def barrier(self):
+        import jax
+
+        jax.block_until_ready(self.allreduce([np.zeros(1)] * self.world_size)[0])
+
+    def destroy(self):
+        self._mesh = None

@@ -8,12 +8,21 @@ import os
 # if the ambient env says "axon" (the single-TPU tunnel): tests never touch
 # the real chip, and a second TPU claim would deadlock against bench runs.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the axon sitecustomize must not tunnel-claim the TPU from test processes
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("RAY_TPU_TESTING", "1")
+
+# sitecustomize imports jax before this file runs, so the env vars above are
+# too late for jax's import-time config snapshot — force it via the config API
+# (safe: the backend itself is still uninitialized at collection time).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,168 @@
+"""Actor API tests (reference tier: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 6
+    assert ray_tpu.get(c.inc.remote(4), timeout=60) == 10
+    assert ray_tpu.get(c.value.remote(), timeout=60) == 10
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(1, 21))
+
+
+def test_actor_isolation(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote(100)
+    ray_tpu.get([a.inc.remote(), b.inc.remote()], timeout=60)
+    assert ray_tpu.get(a.value.remote(), timeout=60) == 1
+    assert ray_tpu.get(b.value.remote(), timeout=60) == 101
+
+
+def test_actor_method_error(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(c.fail.remote(), timeout=60)
+    # actor survives a method error
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+
+def test_actor_own_process(ray_start_regular):
+    import os
+
+    c = Counter.remote()
+    pid = ray_tpu.get(c.pid.remote(), timeout=60)
+    assert pid != os.getpid()
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="named_counter").remote(7)
+    h = ray_tpu.get_actor("named_counter")
+    assert ray_tpu.get(h.value.remote(), timeout=60) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.value.remote(), timeout=60)
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.value.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.v = 0
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            self.v += 1
+            return self.v
+
+    f = Flaky.remote()
+    assert ray_tpu.get(f.ping.remote(), timeout=60) == 1
+    f.crash.remote()
+    time.sleep(2.0)
+    # restarted: state reset, still serving
+    deadline = time.time() + 60
+    while True:
+        try:
+            v = ray_tpu.get(f.ping.remote(), timeout=30)
+            break
+        except RayActorError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert v == 1
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(h):
+        return ray_tpu.get(h.inc.remote(), timeout=60)
+
+    assert ray_tpu.get(bump.remote(c), timeout=120) == 1
+    assert ray_tpu.get(c.value.remote(), timeout=60) == 1
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.work.remote(21), timeout=60) == 42
+
+
+def test_max_concurrency_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def slow(self):
+            time.sleep(0.5)
+            return 1
+
+    p = Parallel.remote()
+    ray_tpu.get(p.slow.remote(), timeout=60)  # warm up: actor process spawn
+    t0 = time.time()
+    refs = [p.slow.remote() for _ in range(4)]
+    assert sum(ray_tpu.get(refs, timeout=60)) == 4
+    # 4 overlapping 0.5s calls should take well under 2s serial time
+    assert time.time() - t0 < 1.9
+
+
+def test_actor_creation_error(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def ping(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((RayActorError, ValueError)):
+        ray_tpu.get(b.ping.remote(), timeout=60)

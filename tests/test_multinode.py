@@ -1,0 +1,84 @@
+"""Multi-node behavior via the in-one-machine Cluster harness
+(reference tier: python/ray/tests with ray_start_cluster fixtures +
+test_chaos.py node-killing)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_add_node_expands_resources(cluster):
+    ray_tpu.init(address=cluster.address)
+    assert ray_tpu.cluster_resources().get("CPU") == 2.0
+    cluster.add_node(num_cpus=4)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.cluster_resources().get("CPU") == 6.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.cluster_resources().get("CPU") == 6.0
+
+
+def test_task_runs_on_remote_node(cluster):
+    ray_tpu.init(address=cluster.address)
+    node = cluster.add_node(num_cpus=4, resources={"special": 1.0})
+
+    @ray_tpu.remote(resources={"special": 1.0})
+    def where():
+        import os
+
+        return os.getpid()
+
+    pid = ray_tpu.get(where.remote(), timeout=120)
+    assert pid > 0
+    nodes = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert node.node_id in nodes
+
+
+def test_node_death_retries_task(cluster):
+    ray_tpu.init(address=cluster.address)
+    node = cluster.add_node(num_cpus=1, resources={"only_there": 1.0})
+
+    @ray_tpu.remote(resources={"only_there": 1.0}, max_retries=2)
+    def slow():
+        import time as t
+
+        t.sleep(5)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(2.0)  # let it start on the doomed node
+    cluster.remove_node(node, allow_graceful=False)
+    # task becomes unschedulable (resource gone) or retried; either way the
+    # system must not hang silently — add the node back and it completes
+    cluster.add_node(num_cpus=1, resources={"only_there": 1.0})
+    assert ray_tpu.get(ref, timeout=180) == "done"
+
+
+def test_strict_spread_across_nodes(cluster):
+    ray_tpu.init(address=cluster.address)
+    cluster.add_node(num_cpus=2)
+    from ray_tpu.util import placement_group
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.cluster_resources().get("CPU", 0) >= 4.0:
+            break
+        time.sleep(0.2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    from ray_tpu.util.placement_group import placement_group_table
+
+    table = placement_group_table(pg)
+    nodes = table["bundle_nodes"]
+    assert nodes[0] != nodes[1]

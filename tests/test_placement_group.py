@@ -1,0 +1,95 @@
+"""Placement group tests (reference tier:
+python/ray/tests/test_placement_group.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import placement_group, placement_group_table, remove_placement_group
+
+
+def test_pg_create_and_ready(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    assert len(table["bundle_nodes"]) == 2
+
+
+def test_pg_task_placement(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    r = f.options(placement_group=pg, placement_group_bundle_index=0).remote()
+    assert ray_tpu.get(r, timeout=60) == 1
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 3}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) <= 1.0
+    remove_placement_group(pg)
+    import time
+
+    time.sleep(0.5)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) >= 3.0
+
+
+def test_pg_infeasible_pending(ray_start_regular):
+    # more CPU than the cluster has: stays pending, ready() times out
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    assert pg.ready(timeout=1.0) is False
+    remove_placement_group(pg)
+
+
+def test_pg_actor_placement(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(placement_group=pg, placement_group_bundle_index=0).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_pg_strict_spread_infeasible_on_one_node(ray_start_regular):
+    # single node: STRICT_SPREAD with 2 bundles cannot place
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=1.0) is False
+    remove_placement_group(pg)
+
+
+def test_pg_bundle_capacity_respected(ray_start_regular):
+    import time
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(3)
+        return 1
+
+    # two tasks into a 1-CPU bundle: must serialize
+    t0 = time.time()
+    refs = [
+        slow.options(placement_group=pg, placement_group_bundle_index=0).remote()
+        for _ in range(2)
+    ]
+    assert ray_tpu.get(refs, timeout=120) == [1, 1]
+    assert time.time() - t0 >= 5.5
+
+
+def test_pg_invalid_args(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="BOGUS")
