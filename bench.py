@@ -1,0 +1,89 @@
+"""Headline benchmark: GPT-2 124M training throughput on the real TPU.
+
+Prints ONE JSON line:
+  {"metric": "gpt2_124m_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": MFU/0.45, ...}
+
+vs_baseline is measured MFU against the north-star 45% MFU target from
+BASELINE.json (reference repo publishes no absolute numbers — BASELINE.md).
+
+Run with the ambient env (sole TPU claimant).  Everything else in this repo
+runs on cpu; only this script touches the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# bf16 peak FLOP/s per chip by generation
+_PEAK = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from ray_tpu.models.lm_train import make_train_step, synthetic_batch
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK.get(gen, _PEAK["v5e"])
+    on_tpu = platform not in ("cpu",)
+
+    cfg = GPT2Config.gpt2_124m()
+    model = GPT2Model(cfg)
+    mesh = make_mesh(MeshConfig(dp=1), devices[:1])
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    seq = cfg.block_size
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    bundle = make_train_step(model, mesh, learning_rate=3e-4)
+    params, opt_state = bundle.init(jax.random.PRNGKey(0))
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+    tokens = jax.device_put(tokens, bundle.batch_sharding)
+    targets = jax.device_put(targets, bundle.batch_sharding)
+
+    # warmup (compile); a host fetch is the sync barrier — block_until_ready
+    # is unreliable on the experimental axon PJRT backend
+    for _ in range(2):
+        params, opt_state, metrics = bundle.step(params, opt_state, tokens, targets)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = bundle.step(params, opt_state, tokens, targets)
+    final_loss = float(metrics["loss"])  # forces the whole step chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    mfu = tokens_per_sec * cfg.flops_per_token() / peak
+    result = {
+        "metric": "gpt2_124m_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "platform": platform,
+        "tpu_gen": gen if on_tpu else "cpu-fallback",
+        "batch": batch,
+        "seq": seq,
+        "step_ms": round(1000 * dt / steps, 2),
+        "loss": round(final_loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

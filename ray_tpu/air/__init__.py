@@ -1,0 +1,4 @@
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig  # noqa: F401
+from ray_tpu.air import session  # noqa: F401
+from ray_tpu.air.result import Result  # noqa: F401

@@ -1,0 +1,1 @@
+from ray_tpu.models.gpt2 import GPT2Config, GPT2Model  # noqa: F401

@@ -1,0 +1,275 @@
+"""GPT-2 family, TPU-first.
+
+The flagship model for the Train stack (BASELINE configs #2 and #4: 124M
+data-parallel, 1.5B with ZeRO-1).  Design choices are MXU/HBM-driven, not a
+port of any torch modeling code:
+
+- params are a plain pytree with a *stacked* [n_layer, ...] leading dim and
+  the forward is one `lax.scan` over layers → one compiled layer body,
+  `jax.checkpoint` per layer for rematerialization (HBM ⇄ FLOPs trade).
+- compute in bfloat16 (MXU native), master params float32, loss/softmax in
+  float32; vocab padded to a multiple of 128 so the logits matmul tiles
+  cleanly onto the 128×128 systolic array.
+- sharding is declared, not wired: `param_pspecs()` returns a PartitionSpec
+  pytree over the standard mesh axes (tp shards attention heads / mlp
+  hidden / vocab; fsdp shards the stacked layer dim; dp replicates), so the
+  same model runs single-chip or on any Mesh via pjit with no code change.
+- sequence parallelism: pass `mesh_axis_sp` to route attention through
+  ring_attention (sequence sharded over the `sp` axis).
+
+Reference surface parity: the reference ships no LM of its own — its Train
+layer wraps user torch modules (reference: python/ray/train/torch/
+train_loop_utils.py prepare_model).  This model is the `train_loop` payload
+for our equivalents of the AIR GPT-2 release benchmarks
+(reference: release/air_tests/air_benchmarks/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    dropout: float = 0.0  # benchmarks run dropout-free (jit-friendly default)
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_ring_attention: bool = False
+
+    @property
+    def padded_vocab(self) -> int:
+        # 128-lane tiling for the MXU; 50257 → 50304
+        return _round_up(self.vocab_size, 128)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @classmethod
+    def gpt2_124m(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=12, n_head=12, n_embd=768, **kw)
+
+    @classmethod
+    def gpt2_350m(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=24, n_head=16, n_embd=1024, **kw)
+
+    @classmethod
+    def gpt2_774m(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=36, n_head=20, n_embd=1280, **kw)
+
+    @classmethod
+    def gpt2_1p5b(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=48, n_head=25, n_embd=1600, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        """CPU-testable toy (virtual-mesh tests, dryruns)."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("block_size", 64)
+        return cls(n_layer=2, n_head=2, n_embd=64, **kw)
+
+    def num_params(self) -> int:
+        V, L, E = self.padded_vocab, self.n_layer, self.n_embd
+        per_layer = 12 * E * E + 13 * E  # qkv+proj+mlp(4x) + biases + 2 ln
+        return V * E + self.block_size * E + L * per_layer + 2 * E
+
+    def flops_per_token(self) -> float:
+        """Training FLOPs/token ≈ 6N + attention term (PaLM appendix
+        convention) — the MFU denominator."""
+        N = self.num_params() - self.padded_vocab * self.n_embd  # non-embedding
+        attn = 6 * self.n_layer * self.n_embd * self.block_size  # 2*3 * L*E*S
+        return 6.0 * N + attn
+
+
+class GPT2Model:
+    """Functional model: params are an explicit pytree; every method is
+    jit/pjit-friendly (no hidden state)."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    # ------------------------------------------------------------ params
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        E, L, V, S = cfg.n_embd, cfg.n_layer, cfg.padded_vocab, cfg.block_size
+        H = cfg.n_head
+        k = iter(jax.random.split(rng, 16))
+        std = 0.02
+        proj_std = std / math.sqrt(2 * L)  # GPT-2 residual-stream scaling
+        pd = cfg.param_dtype
+
+        def norm(key, shape, s):
+            return (jax.random.normal(key, shape) * s).astype(pd)
+
+        params = {
+            "wte": norm(next(k), (V, E), std),
+            "wpe": norm(next(k), (S, E), std),
+            "ln_f": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
+            "layers": {
+                "ln1_scale": jnp.ones((L, E), pd),
+                "ln1_bias": jnp.zeros((L, E), pd),
+                "ln2_scale": jnp.ones((L, E), pd),
+                "ln2_bias": jnp.zeros((L, E), pd),
+                "qkv_w": norm(next(k), (L, E, 3 * E), std),
+                "qkv_b": jnp.zeros((L, 3 * E), pd),
+                "proj_w": norm(next(k), (L, E, E), proj_std),
+                "proj_b": jnp.zeros((L, E), pd),
+                "mlp_in_w": norm(next(k), (L, E, 4 * E), std),
+                "mlp_in_b": jnp.zeros((L, 4 * E), pd),
+                "mlp_out_w": norm(next(k), (L, 4 * E, E), proj_std),
+                "mlp_out_b": jnp.zeros((L, E), pd),
+            },
+        }
+        return params
+
+    def param_pspecs(self) -> Dict[str, Any]:
+        """PartitionSpecs over the standard mesh axes.  tp shards the
+        contraction-free dim of each matmul (megatron column/row split);
+        fsdp shards the stacked layer dim (ZeRO-3-style param sharding —
+        all-gather per layer inside scan); embeddings shard vocab on tp."""
+        return {
+            "wte": P("tp", None),
+            "wpe": P(None, None),
+            "ln_f": {"scale": P(None), "bias": P(None)},
+            "layers": {
+                "ln1_scale": P("fsdp", None),
+                "ln1_bias": P("fsdp", None),
+                "ln2_scale": P("fsdp", None),
+                "ln2_bias": P("fsdp", None),
+                "qkv_w": P("fsdp", None, "tp"),
+                "qkv_b": P("fsdp", "tp"),
+                "proj_w": P("fsdp", "tp", None),
+                "proj_b": P("fsdp", None),
+                "mlp_in_w": P("fsdp", None, "tp"),
+                "mlp_in_b": P("fsdp", "tp"),
+                "mlp_out_w": P("fsdp", "tp", None),
+                "mlp_out_b": P("fsdp", None),
+            },
+        }
+
+    # ----------------------------------------------------------- forward
+
+    def _layer(self, x: jax.Array, layer_params: Dict[str, jax.Array], mesh) -> jax.Array:
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S, E = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+
+        def ln(h, scale, bias):
+            h32 = h.astype(jnp.float32)
+            mu = h32.mean(-1, keepdims=True)
+            var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
+            return ((h32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias).astype(cd)
+
+        h = ln(x, layer_params["ln1_scale"].astype(jnp.float32), layer_params["ln1_bias"].astype(jnp.float32))
+        qkv = h @ layer_params["qkv_w"].astype(cd) + layer_params["qkv_b"].astype(cd)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k_ = k_.reshape(B, S, H, D)
+        v_ = v_.reshape(B, S, H, D)
+        if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # sequence parallelism: drop into SPMD-per-device code for the
+            # attention only — the K/V ring rides ppermute over the sp axis
+            import functools as _ft
+
+            from ray_tpu.parallel.ring_attention import ring_attention
+
+            try:
+                from jax import shard_map
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
+
+            data = tuple(
+                a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+            )
+            spec = jax.sharding.PartitionSpec(data or None, "sp", None, None)
+            attn = shard_map(
+                _ft.partial(ring_attention, axis_name="sp", causal=True),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k_, v_)
+        else:
+            attn = self._causal_attention(q, k_, v_)
+        attn = attn.reshape(B, S, E)
+        x = x + (attn @ layer_params["proj_w"].astype(cd) + layer_params["proj_b"].astype(cd))
+
+        h = ln(x, layer_params["ln2_scale"].astype(jnp.float32), layer_params["ln2_bias"].astype(jnp.float32))
+        h = h @ layer_params["mlp_in_w"].astype(cd) + layer_params["mlp_in_b"].astype(cd)
+        h = jax.nn.gelu(h)
+        x = x + (h @ layer_params["mlp_out_w"].astype(cd) + layer_params["mlp_out_b"].astype(cd))
+        return x
+
+    def _causal_attention(self, q, k, v):
+        cfg = self.config
+        B, S, H, D = q.shape
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.compute_dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        mesh=None,
+    ) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, padded_vocab] float32."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S = tokens.shape
+        x = params["wte"].astype(cd)[tokens] + params["wpe"].astype(cd)[:S][None]
+
+        def scan_body(x, layer_params):
+            if cfg.remat:
+                y = jax.checkpoint(lambda x_, lp: self._layer(x_, lp, mesh))(x, layer_params)
+            else:
+                y = self._layer(x, layer_params, mesh)
+            return y, None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        scale = params["ln_f"]["scale"].astype(jnp.float32)
+        bias = params["ln_f"]["bias"].astype(jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+        x = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+        logits = x.astype(cd) @ params["wte"].astype(cd).T
+        return logits.astype(jnp.float32)
+
+    def loss(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        targets: jax.Array,
+        mesh=None,
+    ) -> jax.Array:
+        """Mean next-token cross entropy; padded-vocab tail masked out."""
+        cfg = self.config
+        logits = self.apply(params, tokens, mesh)
+        if cfg.padded_vocab != cfg.vocab_size:
+            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30, logits.dtype)
+            logits = logits.at[..., cfg.vocab_size :].set(neg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()

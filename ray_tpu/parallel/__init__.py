@@ -1,0 +1,1 @@
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401

@@ -1,0 +1,9 @@
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig  # noqa: F401
+from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.train.backend import Backend, BackendConfig  # noqa: F401
+from ray_tpu.train.base_trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+)

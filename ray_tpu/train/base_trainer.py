@@ -1,0 +1,136 @@
+"""BaseTrainer + DataParallelTrainer.
+
+Analog of the reference's trainer stack (reference:
+python/ray/train/base_trainer.py:339 fit, train/data_parallel_trainer.py:52
+DataParallelTrainer → BackendExecutor → WorkerGroup → Backend.on_start →
+per-worker sessions).  The reference routes fit() through Tune
+(base_trainer.py:339-365 as_trainable); we run the executor directly and
+expose as_trainable() for the Tune layer to wrap — same contract, one less
+mandatory hop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+from ray_tpu.train.backend import BackendConfig
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap into a Tune Trainable (reference: base_trainer.py:365)."""
+        from ray_tpu.tune.trainable import FunctionTrainable
+
+        trainer = self
+
+        def _train_fn(config):
+            from ray_tpu.air import session as air_session
+
+            result = trainer.fit()
+            air_session.report(result.metrics)
+
+        return _train_fn
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs `train_loop_per_worker` on N worker actors
+    (reference: data_parallel_trainer.py:52)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or BackendConfig()
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        latest_checkpoint: Optional[Checkpoint] = self.resume_from_checkpoint
+        while True:
+            try:
+                return self._fit_once(latest_checkpoint)
+            except RuntimeError as e:
+                attempt += 1
+                if attempt > max_failures:
+                    raise
+                # elastic recovery at group granularity: rebuild the whole
+                # worker gang and resume from the last checkpoint
+                # (reference: backend_executor.py:462,512 _restart)
+                time.sleep(1.0)
+
+    def _fit_once(self, checkpoint: Optional[Checkpoint]) -> Result:
+        executor = BackendExecutor(
+            self.backend_config, self.scaling_config, self.run_config.failure_config
+        )
+        metrics_history = []
+        last_metrics: Dict[str, Any] = {}
+        last_checkpoint = checkpoint
+        try:
+            executor.start()
+            executor.start_training(self.train_loop, self.train_loop_config, checkpoint)
+            while True:
+                round_results = executor.get_next_results()
+                if round_results is None:
+                    break
+                reports = [p for kind, p in round_results if kind == "report"]
+                if not reports:
+                    continue
+                # rank-0's metrics are the canonical row (reference behavior)
+                metrics, ckpt_data = reports[0]
+                metrics_history.append(metrics)
+                last_metrics = metrics
+                for m, cd in reports:
+                    if cd is not None:
+                        last_checkpoint = Checkpoint.from_dict(cd)
+            return Result(
+                metrics=last_metrics,
+                checkpoint=last_checkpoint,
+                metrics_history=metrics_history,
+            )
+        finally:
+            executor.shutdown()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the Jax backend default
+    (the TorchTrainer analog — reference: train/torch/torch_trainer.py:208)."""
+
+    def __init__(self, train_loop_per_worker, **kwargs):
+        from ray_tpu.train.jax.config import JaxConfig
+
+        kwargs.setdefault("backend_config", JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

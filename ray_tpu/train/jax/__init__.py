@@ -1,0 +1,6 @@
+from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
+from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
+    all_reduce_gradients,
+    get_data_shard,
+    local_mesh,
+)

@@ -1,0 +1,100 @@
+"""JaxConfig backend: the TPU-era analog of _TorchBackend.
+
+The reference's torch backend picks MASTER_ADDR/PORT on rank 0 and calls
+torch.distributed.init_process_group("nccl") on every worker actor
+(reference: python/ray/train/torch/config.py:69,120-174).  The jax
+equivalent has two regimes:
+
+- single-host (this machine, N worker actors): each actor is its own jax
+  process on its own devices; cross-actor gradient DP uses the `dcn`
+  collective ring (ray_tpu.util.collective) — group joined here at
+  on_start, exactly where torch ran init_process_group.
+- multi-host TPU pod: each worker actor owns one host's chips; on_start
+  runs jax.distributed.initialize(coordinator, num_processes, process_id)
+  with the coordinator address rendezvoused through the head KV, after
+  which ICI collectives span the pod and the dcn ring is unnecessary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+TRAIN_GROUP = "_train_dp"
+
+
+def _join_collective(worker, world_size, rank, backend, group_name):
+    from ray_tpu.util.collective import init_collective_group
+
+    init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+    return True
+
+
+def _init_jax_distributed(worker, coordinator, num_processes, process_id):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def _leave_collective(worker, group_name):
+    from ray_tpu.util.collective import destroy_collective_group
+
+    try:
+        destroy_collective_group(group_name)
+    except Exception:
+        pass
+    return True
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    use_jax_distributed: bool = False  # multi-host pod regime
+    collective_backend: str = "dcn"  # cross-actor grad reduction transport
+    group_name: str = TRAIN_GROUP
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, config: JaxConfig):
+        n = len(worker_group)
+        if config.use_jax_distributed:
+            # rank 0's host:port becomes the coordination service address
+            # (reference analog: MASTER_ADDR/PORT broadcast, config.py:123-160)
+            import socket
+
+            host = socket.gethostbyname(socket.gethostname())
+            port = 8476
+            coordinator = f"{host}:{port}"
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(_init_jax_distributed, coordinator, n, rank)
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs, timeout=300)
+        if n > 1:
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(
+                    _join_collective, n, rank, config.collective_backend, config.group_name
+                )
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group, config: JaxConfig):
+        if len(worker_group) > 1:
+            try:
+                worker_group.execute(_leave_collective, config.group_name, timeout=30)
+            except Exception:
+                pass
