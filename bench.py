@@ -41,7 +41,14 @@ def main():
     peak = _PEAK.get(gen, _PEAK["v5e"])
     on_tpu = platform not in ("cpu",)
 
-    cfg = GPT2Config.gpt2_124m()
+    model_name = os.environ.get("BENCH_MODEL", "gpt2_124m")
+    cfg_kw = {}
+    if os.environ.get("BENCH_REMAT"):
+        cfg_kw["remat_policy"] = os.environ["BENCH_REMAT"]
+        cfg_kw["remat"] = os.environ["BENCH_REMAT"] != "none"
+    if os.environ.get("BENCH_ATTN"):
+        cfg_kw["attention_impl"] = os.environ["BENCH_ATTN"]
+    cfg = getattr(GPT2Config, model_name)(**cfg_kw)
     model = GPT2Model(cfg)
     mesh = make_mesh(MeshConfig(dp=1), devices[:1])
 

@@ -121,11 +121,17 @@ class WorkerRuntime:
         if spec.task_type == ACTOR_CREATION_TASK:
             cls = self.cw.fetch_function(spec.function_id)
             self.actor.cls = cls
-            if spec.max_concurrency > 1:
-                self.actor.executor = ThreadPoolExecutor(max_workers=spec.max_concurrency)
-                self._concurrency_sem = threading.Semaphore(spec.max_concurrency)
             if _is_async_actor(cls):
+                # async actors process calls concurrently on one event loop
+                # (reference: fiber-based async actors, core_worker fiber.h;
+                # default max concurrency 1000 for asyncio actors)
                 self._start_async_loop()
+                concurrency = max(spec.max_concurrency, 100)
+            else:
+                concurrency = spec.max_concurrency
+            if concurrency > 1:
+                self.actor.executor = ThreadPoolExecutor(max_workers=concurrency)
+                self._concurrency_sem = threading.Semaphore(concurrency)
             self.actor.instance = cls(*args, **kwargs)
             return None
         if spec.task_type == ACTOR_TASK:

@@ -51,6 +51,11 @@ class GPT2Config:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "full" recomputes everything; "dots" saves matmul outputs and only
+    # recomputes elementwise ops (less FLOP overhead, more HBM)
+    remat_policy: str = "dots"
+    # "auto": pallas flash kernel on TPU, xla einsum elsewhere
+    attention_impl: str = "auto"
     use_ring_attention: bool = False
 
     @property
@@ -91,10 +96,12 @@ class GPT2Config:
         return V * E + self.block_size * E + L * per_layer + 2 * E
 
     def flops_per_token(self) -> float:
-        """Training FLOPs/token ≈ 6N + attention term (PaLM appendix
-        convention) — the MFU denominator."""
-        N = self.num_params() - self.padded_vocab * self.n_embd  # non-embedding
-        attn = 6 * self.n_layer * self.n_embd * self.block_size  # 2*3 * L*E*S
+        """Training FLOPs/token = 6N + 12·L·E·S (PaLM appendix / nanoGPT
+        convention): N is total params — wte is tied, used as both input
+        embedding and the logits head matmul — plus the attention
+        score/value matmuls.  This is the MFU numerator per token."""
+        N = self.num_params()
+        attn = 12 * self.n_layer * self.n_embd * self.block_size
         return 6.0 * N + attn
 
 
@@ -220,13 +227,9 @@ class GPT2Model:
         return x
 
     def _causal_attention(self, q, k, v):
-        cfg = self.config
-        B, S, H, D = q.shape
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.compute_dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        from ray_tpu.ops.attention import causal_attention
+
+        return causal_attention(q, k, v, impl=self.config.attention_impl)
 
     def apply(
         self,
@@ -240,9 +243,16 @@ class GPT2Model:
         B, S = tokens.shape
         x = params["wte"].astype(cd)[tokens] + params["wpe"].astype(cd)[:S][None]
 
+        if cfg.remat and cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        else:
+            policy = None
+
         def scan_body(x, layer_params):
             if cfg.remat:
-                y = jax.checkpoint(lambda x_, lp: self._layer(x_, lp, mesh))(x, layer_params)
+                y = jax.checkpoint(
+                    lambda x_, lp: self._layer(x_, lp, mesh), policy=policy
+                )(x, layer_params)
             else:
                 y = self._layer(x, layer_params, mesh)
             return y, None
@@ -264,12 +274,15 @@ class GPT2Model:
         targets: jax.Array,
         mesh=None,
     ) -> jax.Array:
-        """Mean next-token cross entropy; padded-vocab tail masked out."""
+        """Mean next-token cross entropy; padded-vocab tail masked out.
+
+        Fused form: label logit gather + logsumexp — never materializes a
+        full log-softmax tensor (saves one [B,S,V] f32 HBM round-trip)."""
         cfg = self.config
         logits = self.apply(params, tokens, mesh)
         if cfg.padded_vocab != cfg.vocab_size:
             neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30, logits.dtype)
             logits = logits.at[..., cfg.vocab_size :].set(neg)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        label_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        return (lse - label_logit).mean()

@@ -1,0 +1,1 @@
+from ray_tpu.ops.attention import causal_attention  # noqa: F401

@@ -1,0 +1,78 @@
+"""Attention ops: pallas flash kernel on TPU, fused-XLA fallback elsewhere.
+
+The hot op of the model zoo.  On TPU we dispatch to the pallas flash
+attention kernel (VMEM-blocked online softmax — no [S, S] score tensor
+ever hits HBM; differentiable via its custom_vjp), using jax's in-tree
+pallas op.  On CPU (tests, dryruns) we fall back to a plain einsum
+composition that XLA fuses adequately at test scale.
+
+Layouts: this module takes [batch, seq, heads, head_dim] (the model's
+native layout) and transposes at the boundary to the kernel's
+[batch, heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_causal_attention(q, k, v, sm_scale):
+    S = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _flash():
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
+
+    return flash_attention, BlockSizes
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Causal MHA.  q,k,v: [B, S, H, D] → [B, S, H, D].
+
+    impl: "auto" (flash on TPU, xla elsewhere) | "flash" | "xla".
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    # Measured on v5e (GPT-2 base, S=1024, D=64): the XLA fused path beats
+    # the pallas flash kernel — D=64 leaves half the 128-lane MXU idle in
+    # the kernel, and at short S the [S,S] tile pressure XLA pays is small.
+    # Flash wins once S is long enough that score tensors stop fitting.
+    use_flash = impl == "flash" or (
+        impl == "auto" and _on_tpu() and q.shape[1] >= 2048
+    )
+    if not use_flash:
+        return _xla_causal_attention(q, k, v, sm_scale)
+    flash_attention, BlockSizes = _flash()
+    # kernel layout: [B, H, S, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = flash_attention(qt, kt, vt, causal=True, sm_scale=sm_scale)
+    return out.transpose(0, 2, 1, 3)

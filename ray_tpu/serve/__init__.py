@@ -1,0 +1,12 @@
+from ray_tpu.serve.api import (  # noqa: F401
+    Deployment,
+    autoscale_tick,
+    delete,
+    deployment,
+    get_deployment_handle,
+    list_deployments,
+    run,
+    shutdown,
+    start_http_proxy,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401

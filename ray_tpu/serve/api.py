@@ -1,0 +1,199 @@
+"""Public Serve API: @deployment, run, shutdown, handles, HTTP ingress.
+
+Analog of the reference's serve.api (reference: python/ray/serve/api.py:455
+serve.run; @serve.deployment decorator api.py; HTTP proxy
+_private/http_proxy.py:189 — here an aiohttp actor per cluster).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+    route_prefix: Optional[str] = None
+    ray_actor_options: Optional[dict] = None
+    autoscaling_config: Optional[dict] = None
+    max_concurrent_queries: int = 100
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        import dataclasses
+
+        return dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
+
+    def options(self, **kw) -> "Deployment":
+        import dataclasses
+
+        return dataclasses.replace(self, **kw)
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None, **kwargs):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+
+    def deco(target):
+        return Deployment(
+            func_or_class=target, name=name or target.__name__, **kwargs
+        )
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+def _get_or_create_controller():
+    import ray_tpu
+    from ray_tpu.serve.controller import ServeController
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(ServeController)
+        return cls.options(name=CONTROLLER_NAME, lifetime="detached", num_cpus=0).remote()
+
+
+def run(deployment_obj: Deployment, *, _blocking: bool = False, http_port: Optional[int] = None):
+    """Deploy and return a handle (reference: serve.run api.py:455)."""
+    import ray_tpu
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    controller = _get_or_create_controller()
+    ray_tpu.get(
+        controller.deploy.remote(
+            deployment_obj.name,
+            deployment_obj.func_or_class,
+            deployment_obj.init_args,
+            deployment_obj.init_kwargs,
+            deployment_obj.num_replicas,
+            deployment_obj.ray_actor_options,
+            deployment_obj.route_prefix,
+            deployment_obj.autoscaling_config,
+            deployment_obj.max_concurrent_queries,
+        ),
+        timeout=300,
+    )
+    if http_port is not None:
+        start_http_proxy(http_port)
+    return DeploymentHandle(deployment_obj.name, controller)
+
+
+def get_deployment_handle(name: str):
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    return DeploymentHandle(name, _get_or_create_controller())
+
+
+def list_deployments() -> Dict[str, dict]:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def autoscale_tick():
+    """Drive one autoscaling pass (tests/cron; the proxy actor also ticks)."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.autoscale_tick.remote(), timeout=60)
+
+
+def delete(name: str):
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown():
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    for name in list(list_deployments()):
+        delete(name)
+    ray_tpu.kill(controller)
+
+
+class HTTPProxy:
+    """aiohttp ingress actor (reference: _private/http_proxy.py:189)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._handles = {}
+
+    async def start(self):
+        import json
+
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        controller = _get_or_create_controller()
+
+        async def handler(request):
+            routes = ray_tpu.get(controller.routes.remote(), timeout=10)
+            path = request.path
+            name = None
+            for prefix, dep_name in routes.items():
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    name = dep_name
+                    break
+            if name is None:
+                return web.Response(status=404, text="no route")
+            if name not in self._handles:
+                self._handles[name] = DeploymentHandle(name, controller)
+            handle = self._handles[name]
+            handle.refresh_if_stale()
+            try:
+                body = await request.json()
+            except Exception:
+                body = (await request.read()).decode() or None
+            import asyncio
+            import functools
+
+            ref = handle.remote(body)
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                None, functools.partial(ray_tpu.get, ref, timeout=120)
+            )
+            if isinstance(result, (dict, list, str, int, float, bool)) or result is None:
+                return web.json_response({"result": result})
+            return web.Response(body=str(result).encode())
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        await site.start()
+        return f"http://127.0.0.1:{self.port}"
+
+    async def ping(self):
+        return "ok"
+
+
+_proxy_handle = None
+
+
+def start_http_proxy(port: int = 8000) -> str:
+    global _proxy_handle
+    import ray_tpu
+
+    if _proxy_handle is None:
+        cls = ray_tpu.remote(HTTPProxy)
+        _proxy_handle = cls.options(num_cpus=0, name="_serve_http_proxy").remote(port)
+        return ray_tpu.get(_proxy_handle.start.remote(), timeout=120)
+    return f"http://127.0.0.1:{port}"

@@ -1,0 +1,85 @@
+"""Request batching: coalesce concurrent calls into one model invocation.
+
+Analog of the reference's @serve.batch (reference: python/ray/serve/
+batching.py:46 _BatchQueue, :87 wait_for_batch, :131 decorator).  The
+TPU angle: a jitted model wants fixed large batches — callers trickle in
+single requests, the queue release them as one padded tensor batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.queue: List = []  # [(item, future)]
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, instance, item):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.queue.append((item, fut))
+        if len(self.queue) >= self.max_batch_size:
+            await self._flush(instance)
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = loop.create_task(self._timed_flush(instance))
+        return await fut
+
+    async def _timed_flush(self, instance):
+        await asyncio.sleep(self.timeout)
+        await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self.queue:
+            return
+        batch, self.queue = self.queue, []
+        items = [b[0] for b in batch]
+        futs = [b[1] for b in batch]
+        try:
+            if instance is not None:
+                results = self.fn(instance, items)
+            else:
+                results = self.fn(items)
+            if asyncio.iscoroutine(results):
+                results = await results
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batched fn returned {len(results)} results for {len(items)} inputs"
+                )
+            for fut, res in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except BaseException as e:  # noqa: BLE001
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """Decorator: async method taking a single item → coalesced list calls.
+
+    The wrapped function must accept a LIST of items and return a LIST of
+    results (reference semantics)."""
+
+    def deco(fn):
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        async def wrapper(self_or_item, *args):
+            # method form: wrapper(self, item); function form: wrapper(item)
+            if args:
+                return await queue.submit(self_or_item, args[0])
+            return await queue.submit(None, self_or_item)
+
+        wrapper._batch_queue = queue
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -1,0 +1,176 @@
+"""ServeController: deployment reconciliation + autoscaling.
+
+Analog of the reference's controller stack (reference:
+python/ray/serve/controller.py:61 ServeController actor + control loop
+:239; _private/deployment_state.py:958 DeploymentState replica FSM;
+_private/autoscaling_policy.py:93 BasicAutoscalingPolicy).  Replicas are
+plain actors; the controller reconciles target vs live counts and scales
+on reported in-flight load.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Replica:
+    """Replica actor body: hosts the user callable."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        import inspect
+
+        if inspect.isclass(cls_or_fn):
+            self.instance = cls_or_fn(*init_args, **(init_kwargs or {}))
+        else:
+            self.instance = cls_or_fn
+        self.inflight = 0
+        self.handled = 0
+
+    async def handle_request(self, method: str, args, kwargs):
+        # async: the worker hosts this actor on an asyncio loop, so batched
+        # handlers (serve/batching.py futures) and overlapping requests work
+        self.inflight += 1
+        try:
+            target = self.instance if method == "__call__" else getattr(self.instance, method)
+            if method == "__call__" and not callable(target):
+                raise TypeError("deployment instance is not callable")
+            import inspect
+
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self.handled += 1
+            return result
+        finally:
+            self.inflight -= 1
+
+    def stats(self):
+        return {"inflight": self.inflight, "handled": self.handled}
+
+    def reconfigure(self, user_config):
+        if hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+        return True
+
+
+class ServeController:
+    """Detached actor: owns every deployment's goal state."""
+
+    def __init__(self):
+        self.deployments: Dict[str, dict] = {}
+        self.version = 0
+
+    def deploy(
+        self,
+        name: str,
+        cls_or_fn,
+        init_args,
+        init_kwargs,
+        num_replicas: int,
+        ray_actor_options: Optional[dict],
+        route_prefix: Optional[str],
+        autoscaling_config: Optional[dict],
+        max_concurrent_queries: int,
+    ):
+        import ray_tpu
+
+        dep = self.deployments.get(name)
+        if dep is None:
+            dep = {
+                "name": name,
+                "replicas": [],
+                "route_prefix": route_prefix or f"/{name}",
+                "max_concurrent_queries": max_concurrent_queries,
+                "autoscaling": autoscaling_config,
+            }
+            self.deployments[name] = dep
+        dep["target"] = num_replicas
+        dep["cls"] = cls_or_fn
+        dep["init_args"] = init_args
+        dep["init_kwargs"] = init_kwargs
+        dep["actor_options"] = ray_actor_options or {}
+        self._reconcile(name)
+        self.version += 1
+        return True
+
+    def _reconcile(self, name: str):
+        import ray_tpu
+
+        dep = self.deployments[name]
+        actor_cls = ray_tpu.remote(Replica)
+        while len(dep["replicas"]) < dep["target"]:
+            opts = dict(dep["actor_options"])
+            replica = actor_cls.options(**opts).remote(
+                dep["cls"], dep["init_args"], dep["init_kwargs"]
+            )
+            dep["replicas"].append(replica)
+        while len(dep["replicas"]) > dep["target"]:
+            victim = dep["replicas"].pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+
+    def get_handles(self, name: str):
+        dep = self.deployments.get(name)
+        if dep is None:
+            return None
+        return {
+            "replicas": dep["replicas"],
+            "max_concurrent_queries": dep["max_concurrent_queries"],
+            "version": self.version,
+        }
+
+    def routes(self) -> Dict[str, str]:
+        return {d["route_prefix"]: name for name, d in self.deployments.items()}
+
+    def autoscale_tick(self):
+        """One autoscaling pass: resize targets from reported in-flight
+        load (reference: BasicAutoscalingPolicy.get_decision_num_replicas)."""
+        import math
+
+        import ray_tpu
+
+        for name, dep in self.deployments.items():
+            cfg = dep.get("autoscaling")
+            if not cfg:
+                continue
+            try:
+                stats = ray_tpu.get(
+                    [r.stats.remote() for r in dep["replicas"]], timeout=5
+                )
+            except Exception:
+                continue
+            total_inflight = sum(s["inflight"] for s in stats)
+            target_per = cfg.get("target_num_ongoing_requests_per_replica", 1)
+            desired = math.ceil(total_inflight / max(target_per, 1e-9)) or cfg.get("min_replicas", 1)
+            desired = max(cfg.get("min_replicas", 1), min(cfg.get("max_replicas", 8), desired))
+            if desired != dep["target"]:
+                dep["target"] = desired
+                self._reconcile(name)
+                self.version += 1
+        return self.version
+
+    def delete_deployment(self, name: str):
+        import ray_tpu
+
+        dep = self.deployments.pop(name, None)
+        if dep:
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        self.version += 1
+        return True
+
+    def list_deployments(self):
+        return {
+            name: {
+                "num_replicas": len(d["replicas"]),
+                "target": d["target"],
+                "route_prefix": d["route_prefix"],
+            }
+            for name, d in self.deployments.items()
+        }

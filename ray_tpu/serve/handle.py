@@ -1,0 +1,95 @@
+"""DeploymentHandle: client-side router to replicas.
+
+Analog of the reference's handle/router pair (reference:
+python/ray/serve/handle.py:225 RayServeHandle.remote →
+_private/router.py:221 ReplicaSet.assign_replica — round-robin with an
+in-flight cap per replica; config updates via long poll :67).  We refresh
+replica membership from the controller on a version poll instead of a
+long-poll push (same effect at this scale).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller):
+        self._name = deployment_name
+        self._controller = controller
+        self._replicas: List = []
+        self._max_inflight = 100
+        self._version = -1
+        self._rr = itertools.count()
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._refresh()
+
+    def _refresh(self):
+        import ray_tpu
+
+        info = ray_tpu.get(self._controller.get_handles.remote(self._name), timeout=30)
+        if info is None:
+            raise ValueError(f"no deployment named {self._name!r}")
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._max_inflight = info["max_concurrent_queries"]
+            self._version = info["version"]
+
+    def _pick_replica(self):
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(f"deployment {self._name} has no replicas")
+            # round-robin, skipping replicas at their in-flight cap
+            for _ in range(n):
+                idx = next(self._rr) % n
+                if self._inflight.get(idx, 0) < self._max_inflight:
+                    self._inflight[idx] = self._inflight.get(idx, 0) + 1
+                    return idx, self._replicas[idx]
+            # all saturated: take the round-robin pick anyway (backpressure
+            # belongs to the replica's queue)
+            idx = next(self._rr) % n
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx, self._replicas[idx]
+
+    def remote(self, *args, **kwargs):
+        """Async submit; returns an ObjectRef."""
+        return self.method("__call__").remote(*args, **kwargs)
+
+    def method(self, method_name: str):
+        handle = self
+
+        class _Method:
+            def remote(self, *args, **kwargs):
+                idx, replica = handle._pick_replica()
+                ref = replica.handle_request.remote(method_name, args, kwargs)
+                # decrement on resolution (best-effort, thread offload)
+                def _done():
+                    import ray_tpu
+
+                    try:
+                        ray_tpu.wait([ref], num_returns=1, timeout=300)
+                    finally:
+                        with handle._lock:
+                            handle._inflight[idx] = max(0, handle._inflight.get(idx, 1) - 1)
+
+                threading.Thread(target=_done, daemon=True).start()
+                return ref
+
+        return _Method()
+
+    def refresh_if_stale(self):
+        import ray_tpu
+
+        try:
+            info = ray_tpu.get(self._controller.get_handles.remote(self._name), timeout=10)
+            if info and info["version"] != self._version:
+                with self._lock:
+                    self._replicas = info["replicas"]
+                    self._version = info["version"]
+        except Exception:
+            pass

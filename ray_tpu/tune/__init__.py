@@ -1,0 +1,10 @@
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.trainable import FunctionTrainable  # noqa: F401
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401

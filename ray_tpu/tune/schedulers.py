@@ -1,0 +1,70 @@
+"""Trial schedulers: FIFO and ASHA.
+
+Analog of the reference's tune.schedulers (reference:
+python/ray/tune/schedulers/async_hyperband.py AsyncHyperBandScheduler —
+rung-based asynchronous successive halving; trial_scheduler.py FIFO).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving: at each rung (training_iteration =
+    grace_period * reduction_factor^k), stop a trial whose metric is below
+    the rung's top-1/reduction_factor quantile."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        max_t: int = 100,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, list] = defaultdict(list)  # rung_t -> recorded scores
+        self._iters: Dict[str, int] = defaultdict(int)
+
+    def _rung_levels(self):
+        levels = []
+        t = self.grace
+        while t < self.max_t:
+            levels.append(t)
+            t *= self.rf
+        return levels
+
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        self._iters[trial_id] += 1
+        t = metrics.get("training_iteration", self._iters[trial_id])
+        score = metrics.get(self.metric)
+        if score is None:
+            return CONTINUE
+        if self.mode == "min":
+            score = -float(score)
+        else:
+            score = float(score)
+        for rung in self._rung_levels():
+            if t == rung:
+                scores = self._rungs[rung]
+                scores.append(score)
+                k = max(1, len(scores) // self.rf)
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+        return CONTINUE

@@ -1,0 +1,69 @@
+"""Trainable actor: runs one trial's function with a report session.
+
+Analog of the reference's Trainable/FunctionTrainable (reference:
+python/ray/tune/trainable/trainable.py:65, function_trainable.py — user
+function runs in a thread, session.report rows stream out).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+
+class FunctionTrainable:
+    """The actor body for a single trial."""
+
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, fn: Callable):
+        from ray_tpu.air import session as air_session
+
+        trainable_self = self
+
+        class _TrialSession:
+            world_rank = 0
+            world_size = 1
+            local_rank = 0
+            loaded_checkpoint = None
+            trial_name = self.trial_id
+
+            def report(self, metrics, checkpoint=None):
+                trainable_self._queue.put(("report", (dict(metrics), None)))
+                if trainable_self._stop.is_set():
+                    raise _TrialStopped()
+
+        def _run():
+            air_session._set_session(_TrialSession())
+            try:
+                fn(self.config)
+                self._queue.put(("done", None))
+            except _TrialStopped:
+                self._queue.put(("done", None))
+            except BaseException as e:  # noqa: BLE001
+                self._queue.put(("error", f"{e}\n{traceback.format_exc()}"))
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return True
+
+    def next_event(self, timeout: float = 60.0):
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return ("pending", None)
+
+    def stop(self):
+        self._stop.set()
+        return True
+
+
+class _TrialStopped(BaseException):
+    pass

@@ -1,0 +1,142 @@
+"""Tuner + TrialRunner: the experiment loop.
+
+Analog of the reference (reference: python/ray/tune/tuner.py:40 Tuner →
+tune/execution/trial_runner.py:236 TrialRunner.step loop →
+ray_trial_executor.py:200 actor-per-trial placement).  Trials are actors;
+their report streams drive the scheduler's continue/stop decisions.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.trainable import FunctionTrainable
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    state: str = "PENDING"
+    actor: Any = None
+    last_metrics: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: str, mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __iter__(self):
+        for t in self._trials:
+            yield Result(metrics=t.last_metrics, metrics_history=t.history)
+
+    @property
+    def trials(self):
+        return self._trials
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        done = [t for t in self._trials if metric in t.last_metrics]
+        if not done:
+            raise ValueError("no trial reported the metric")
+        key = lambda t: t.last_metrics[metric]
+        best = min(done, key=key) if mode == "min" else max(done, key=key)
+        result = Result(metrics=best.last_metrics, metrics_history=best.history)
+        result.config = best.config
+        return result
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        # a Trainer becomes a trainable function (reference: Tuner(trainer))
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial or {"CPU": 1}
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg) for i, cfg in enumerate(variants)]
+        pending = list(trials)
+        running: List[Trial] = []
+        actor_cls = ray_tpu.remote(FunctionTrainable)
+
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                trial = pending.pop(0)
+                trial.actor = actor_cls.options(
+                    num_cpus=self.resources_per_trial.get("CPU", 1),
+                    resources={
+                        k: v for k, v in self.resources_per_trial.items() if k != "CPU"
+                    },
+                ).remote(trial.trial_id, trial.config)
+                ray_tpu.get(trial.actor.start.remote(self.trainable), timeout=120)
+                trial.state = "RUNNING"
+                running.append(trial)
+
+            for trial in list(running):
+                kind, payload = ray_tpu.get(
+                    trial.actor.next_event.options(num_returns=1).remote(1.0), timeout=90
+                )
+                if kind == "report":
+                    metrics, _ckpt = payload
+                    metrics.setdefault("training_iteration", len(trial.history) + 1)
+                    trial.history.append(metrics)
+                    trial.last_metrics = metrics
+                    decision = scheduler.on_result(trial.trial_id, metrics)
+                    if decision == STOP:
+                        ray_tpu.get(trial.actor.stop.remote(), timeout=30)
+                        trial.state = "STOPPED"
+                        ray_tpu.kill(trial.actor)
+                        running.remove(trial)
+                elif kind == "done":
+                    trial.state = "TERMINATED"
+                    ray_tpu.kill(trial.actor)
+                    running.remove(trial)
+                elif kind == "error":
+                    trial.state = "ERROR"
+                    trial.error = payload
+                    ray_tpu.kill(trial.actor)
+                    running.remove(trial)
+        errs = [t for t in trials if t.state == "ERROR"]
+        if errs and len(errs) == len(trials):
+            raise RuntimeError(f"all trials failed; first error:\n{errs[0].error}")
+        return ResultGrid(trials, tc.metric, tc.mode)

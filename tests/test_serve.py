@@ -1,0 +1,133 @@
+"""Serve layer tests (reference tier: python/ray/serve/tests/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(ray_cluster):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    assert ray_tpu.get(handle.remote(7), timeout=120) == 49
+
+
+def test_class_deployment_with_state(ray_cluster):
+    @serve.deployment(name="adder")
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+        def peek(self):
+            return self.offset
+
+    handle = serve.run(Adder.bind(10))
+    assert ray_tpu.get(handle.remote(5), timeout=120) == 15
+    assert ray_tpu.get(handle.method("peek").remote(), timeout=60) == 10
+
+
+def test_multiple_replicas_round_robin(ray_cluster):
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Who.bind())
+    pids = set(ray_tpu.get([handle.remote(None) for _ in range(8)], timeout=120))
+    assert len(pids) == 2
+
+
+def test_batching(ray_cluster):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    results = ray_tpu.get(refs, timeout=120)
+    assert sorted(results) == [0, 2, 4, 6, 8, 10, 12, 14]
+    sizes = ray_tpu.get(handle.method("sizes").remote(), timeout=60)
+    assert max(sizes) > 1, f"requests were never coalesced: {sizes}"
+
+
+def test_jax_model_deployment(ray_cluster):
+    """A jitted jax model behind a deployment — the Serve TPU story
+    (BASELINE config #5 shape at toy scale)."""
+
+    @serve.deployment
+    class JaxModel:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            w = jax.random.normal(jax.random.PRNGKey(0), (8, 8))
+
+            @jax.jit
+            def forward(x):
+                return (jnp.asarray(x, jnp.float32) @ w).sum()
+
+            self.forward = forward
+
+        def __call__(self, x):
+            return float(self.forward(x))
+
+    handle = serve.run(JaxModel.bind())
+    out = ray_tpu.get(handle.remote([[1.0] * 8] * 8), timeout=180)
+    assert isinstance(out, float)
+
+
+def test_http_proxy(ray_cluster):
+    @serve.deployment(route_prefix="/double")
+    def double(x):
+        return x * 2
+
+    serve.run(double.bind())
+    url = serve.start_http_proxy(port=18123)
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        "http://127.0.0.1:18123/double",
+        data=json.dumps(21).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    deadline = time.time() + 60
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(1)
+    assert body["result"] == 42

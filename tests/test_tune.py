@@ -1,0 +1,97 @@
+"""Tune layer tests (reference tier: python/ray/tune/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_grid_search_finds_best(ray_cluster):
+    def objective(config):
+        from ray_tpu.air import session
+
+        session.report({"score": (config["x"] - 3) ** 2})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="min", max_concurrent_trials=3),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_random_sampling(ray_cluster):
+    def objective(config):
+        from ray_tpu.air import session
+
+        session.report({"score": config["lr"]})
+
+    tuner = Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=TuneConfig(metric="score", mode="min", num_samples=4),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    lrs = [t.config["lr"] for t in grid.trials]
+    assert all(1e-5 <= lr <= 1e-1 for lr in lrs)
+    assert len(set(lrs)) == 4
+
+
+def test_asha_stops_bad_trials(ray_cluster):
+    def objective(config):
+        from ray_tpu.air import session
+
+        for i in range(8):
+            # bad configs plateau high; good ones descend
+            loss = config["quality"] * 10 + (8 - i) * 0.1
+            session.report({"loss": loss, "training_iteration": i + 1})
+
+    tuner = Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([0, 1, 2, 3])},
+        tune_config=TuneConfig(
+            metric="loss",
+            mode="min",
+            scheduler=ASHAScheduler(metric="loss", mode="min", grace_period=2, reduction_factor=2, max_t=8),
+            max_concurrent_trials=4,
+        ),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] == 0
+    # at least one inferior trial got stopped early by the scheduler
+    stopped = [t for t in grid.trials if t.state == "STOPPED"]
+    assert stopped, "ASHA should have pruned something"
+
+
+def test_trial_error_isolated(ray_cluster):
+    def objective(config):
+        from ray_tpu.air import session
+
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        session.report({"score": config["x"]})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    states = {t.config["x"]: t.state for t in grid.trials}
+    assert states[1] == "ERROR"
+    assert states[0] == "TERMINATED" and states[2] == "TERMINATED"
+    assert grid.get_best_result().config["x"] == 2
