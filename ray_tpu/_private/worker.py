@@ -98,6 +98,17 @@ def init(
     worker_env = {}
     if _system_config:
         worker_env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(_system_config)
+    # Ship the driver's import path so by-reference cloudpickle functions
+    # (module-level defs outside site-packages) resolve in workers — the
+    # single-machine analog of the reference's working_dir runtime env
+    # (reference: _private/runtime_env/working_dir.py).
+    import sys as _sys
+
+    extra_paths = [p for p in _sys.path if p and p not in ("",)]
+    existing = os.environ.get("PYTHONPATH", "")
+    worker_env["PYTHONPATH"] = os.pathsep.join(
+        dict.fromkeys(extra_paths + ([existing] if existing else []))
+    )
     cw = CoreWorker(host, port, mode="driver", worker_env=worker_env)
     global_worker.core_worker = cw
     global_worker.mode = "driver"

@@ -1,0 +1,131 @@
+"""Algorithm + AlgorithmConfig + PPO.
+
+Analog of the reference's driver loop (reference:
+rllib/algorithms/algorithm.py:145 Algorithm(Trainable), algorithms/ppo/
+ppo.py:401 training_step — synchronous_parallel_sample over the WorkerSet,
+SGD epochs on the collected batch, weight broadcast back to workers).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.sample_batch import ADVANTAGES, SampleBatch
+
+
+@dataclass
+class AlgorithmConfig:
+    env_creator: Optional[Callable] = None
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    train_batch_size: int = 400
+    sgd_minibatch_size: int = 128
+    num_sgd_iter: int = 8
+    lr: float = 3e-4
+    gamma: float = 0.99
+    clip_param: float = 0.2
+    entropy_coeff: float = 0.0
+    seed: int = 0
+
+    def environment(self, env_creator: Callable) -> "AlgorithmConfig":
+        self.env_creator = env_creator
+        return self
+
+    def rollouts(self, num_rollout_workers: int) -> "AlgorithmConfig":
+        self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def stop(self):
+        pass
+
+
+class PPO(Algorithm):
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        from ray_tpu.rllib.policy import JaxPolicy
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        env = config.env_creator()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        del env
+        policy_config = {"lr": config.lr, "clip_param": config.clip_param, "entropy_coeff": config.entropy_coeff}
+        # the learner lives driver-side (on TPU: owns the chips; BASELINE
+        # config #3's "TPU learner"), rollout workers are cpu actors
+        self.policy = JaxPolicy(
+            obs_dim=obs_dim, num_actions=num_actions, seed=config.seed, **policy_config
+        )
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env_creator, policy_config, seed=config.seed + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.time()
+        # broadcast current weights, then sample all workers in parallel
+        weights_ref = ray_tpu.put(self.policy.get_weights())
+        ray_tpu.get([w.set_weights.remote(weights_ref) for w in self.workers], timeout=300)
+        steps_per_worker = max(
+            cfg.rollout_fragment_length, cfg.train_batch_size // max(len(self.workers), 1)
+        )
+        batches = ray_tpu.get(
+            [w.sample.remote(steps_per_worker) for w in self.workers], timeout=600
+        )
+        batch = SampleBatch.concat_samples(batches)
+        # advantage normalization (reference: ppo standardize_fields)
+        adv = batch[ADVANTAGES]
+        batch[ADVANTAGES] = (adv - adv.mean()) / max(adv.std(), 1e-6)
+
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_sgd_iter):
+            shuffled = batch.shuffle(self._rng)
+            for mb in shuffled.minibatches(min(cfg.sgd_minibatch_size, len(shuffled))):
+                metrics = self.policy.learn_on_batch(mb)
+
+        stats = ray_tpu.get(
+            [w.episode_stats.remote() for w in self.workers], timeout=120
+        )
+        self.iteration += 1
+        result = {
+            "training_iteration": self.iteration,
+            "timesteps_this_iter": len(batch),
+            "episode_reward_mean": float(
+                np.mean([s["episode_reward_mean"] for s in stats if s["episodes"] > 0] or [0.0])
+            ),
+            "episodes_total": int(sum(s["episodes"] for s in stats)),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+        return result
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,141 @@
+"""JaxPolicy: actor-critic policy with jitted inference and PPO loss.
+
+The reference stubs a JAX model path but never built the learner
+(reference: rllib/models/jax/jax_modelv2.py, fcnet.py — "JAX stub models",
+SURVEY §2.5); its real learners are torch towers
+(rllib/policy/torch_policy.py:60, learn_on_loaded_batch:538).  This is the
+full JAX realization: MLP π/V, categorical head, clipped-surrogate PPO
+loss, one jitted update — on TPU the same step pmap/pjit-s over chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _mlp_init(rng, sizes):
+    import jax
+
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fan_in, fan_out)) * (2.0 / fan_in) ** 0.5
+        params.append({"w": w, "b": jax.numpy.zeros(fan_out)})
+    return params
+
+
+def _mlp_apply(params, x, final_linear=True):
+    import jax
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or not final_linear:
+            x = jax.numpy.tanh(x)
+    return x
+
+
+class JaxPolicy:
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        hidden: Tuple[int, ...] = (64, 64),
+        lr: float = 3e-4,
+        clip_param: float = 0.2,
+        vf_coeff: float = 0.5,
+        entropy_coeff: float = 0.0,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        rng = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(rng)
+        self.params = {
+            "pi": _mlp_init(k1, (obs_dim, *hidden, num_actions)),
+            "vf": _mlp_init(k2, (obs_dim, *hidden, 1)),
+        }
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.clip_param = clip_param
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        @jax.jit
+        def _forward(params, obs, key):
+            logits = _mlp_apply(params["pi"], obs)
+            value = _mlp_apply(params["vf"], obs)[..., 0]
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), action]
+            return action, logp, value
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, old_logp, advantages, returns):
+            def loss_fn(p):
+                logits = _mlp_apply(p["pi"], obs)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = logp_all[jnp.arange(obs.shape[0]), actions]
+                ratio = jnp.exp(logp - old_logp)
+                clipped = jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param)
+                pi_loss = -jnp.minimum(ratio * advantages, clipped * advantages).mean()
+                value = _mlp_apply(p["vf"], obs)[..., 0]
+                vf_loss = ((value - returns) ** 2).mean()
+                entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+                total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+                return total, {
+                    "policy_loss": pi_loss,
+                    "vf_loss": vf_loss,
+                    "entropy": entropy,
+                }
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._forward = _forward
+        self._update = _update
+
+    # ------------------------------------------------------------- serving
+
+    def compute_actions(self, obs: np.ndarray):
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        action, logp, value = self._forward(self.params, obs.astype(np.float32), key)
+        return np.asarray(action), np.asarray(logp), np.asarray(value)
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        from ray_tpu.rllib.sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
+
+        self.params, self.opt_state, metrics = self._update(
+            self.params,
+            self.opt_state,
+            batch[OBS].astype(np.float32),
+            batch[ACTIONS].astype(np.int32),
+            batch[LOGPS].astype(np.float32),
+            batch[ADVANTAGES].astype(np.float32),
+            batch[RETURNS].astype(np.float32),
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+        import jax
+
+        self.params = jax.tree.map(jnp.asarray, weights)

@@ -1,0 +1,104 @@
+"""RolloutWorker: env-stepping actor producing SampleBatches.
+
+Analog of the reference's RolloutWorker (reference:
+rllib/evaluation/rollout_worker.py:127 init, :792 sample; GAE
+post-processing from rllib/evaluation/postprocessing.py
+compute_advantages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    REWARDS,
+    VALUES,
+    SampleBatch,
+)
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float, lam: float) -> SampleBatch:
+    rewards = batch[REWARDS]
+    values = batch[VALUES]
+    dones = batch[DONES]
+    n = len(rewards)
+    adv = np.zeros(n, np.float32)
+    last_gae = 0.0
+    next_value = last_value
+    for t in reversed(range(n)):
+        nonterminal = 1.0 - float(dones[t])
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    batch[ADVANTAGES] = adv
+    batch[RETURNS] = adv + values
+    return batch
+
+
+class RolloutWorker:
+    """Actor: owns one env (or a vector later) + a policy copy for acting."""
+
+    def __init__(self, env_creator: Callable, policy_config: Dict[str, Any], seed: int = 0):
+        from ray_tpu.rllib.policy import JaxPolicy
+
+        self.env = env_creator()
+        obs_space = self.env.observation_space
+        act_space = self.env.action_space
+        self.policy = JaxPolicy(
+            obs_dim=int(np.prod(obs_space.shape)),
+            num_actions=int(act_space.n),
+            seed=seed,
+            **policy_config,
+        )
+        self._obs, _ = self.env.reset(seed=seed)
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.episode_rewards = []
+        self._ep_reward = 0.0
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        rows = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VALUES)}
+        for _ in range(num_steps):
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            action, logp, value = self.policy.compute_actions(obs[None])
+            a = int(action[0])
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            done = terminated or truncated
+            rows[OBS].append(obs)
+            rows[ACTIONS].append(a)
+            rows[REWARDS].append(float(reward))
+            rows[DONES].append(done)
+            rows[LOGPS].append(float(logp[0]))
+            rows[VALUES].append(float(value[0]))
+            self._ep_reward += float(reward)
+            if done:
+                self.episode_rewards.append(self._ep_reward)
+                self._ep_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+        batch = SampleBatch({k: np.asarray(v) for k, v in rows.items()})
+        # bootstrap value for the unfinished tail
+        obs = np.asarray(self._obs, np.float32).reshape(-1)
+        _, _, last_value = self.policy.compute_actions(obs[None])
+        return compute_gae(batch, float(last_value[0]), self.gamma, self.lam)
+
+    def set_weights(self, weights):
+        self.policy.set_weights(weights)
+        return True
+
+    def episode_stats(self, last_n: int = 20):
+        recent = self.episode_rewards[-last_n:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }
