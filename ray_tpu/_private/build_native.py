@@ -21,6 +21,10 @@ _LIBS = {
         "sources": [os.path.join(_REPO_ROOT, "src", "object_store", "store.cc")],
         "flags": ["-lpthread"],
     },
+    "scheduler": {
+        "sources": [os.path.join(_REPO_ROOT, "src", "scheduler", "scheduler.cc")],
+        "flags": ["-lpthread"],
+    },
 }
 
 

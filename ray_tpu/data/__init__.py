@@ -1,0 +1,7 @@
+from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
+    Dataset,
+    from_items,
+    from_numpy,
+    range,
+)

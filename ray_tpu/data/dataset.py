@@ -1,0 +1,245 @@
+"""Dataset: distributed collections over object-store blocks.
+
+Analog of the reference's ray.data (reference: python/ray/data/dataset.py
+Dataset of plasma-backed blocks; compute strategies data/_internal/
+compute.py:56 TaskPoolStrategy / :150 ActorPoolStrategy; shuffle
+_internal/shuffle.py).  Blocks are lists/numpy batches stored as
+ObjectRefs in the shared-memory store; transforms are tasks (or an actor
+pool) over blocks; zero-copy numpy in/out via the store's pickle5 path.
+
+TPU angle: `iter_batches` feeds jax training with host-resident numpy
+batches read zero-copy from shm — the ingest path Train's dataset shards
+use (reference analog: train/_internal/dataset_spec.py).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private.object_ref import ObjectRef
+
+
+@ray_tpu.remote
+def _map_block(fn, block):
+    return [fn(row) for row in block]
+
+
+@ray_tpu.remote
+def _map_batch(fn, block, batch_format):
+    batch = _to_batch(block, batch_format)
+    out = fn(batch)
+    return _from_batch(out)
+
+
+@ray_tpu.remote
+def _filter_block(fn, block):
+    return [row for row in block if fn(row)]
+
+
+@ray_tpu.remote
+def _concat_blocks(*blocks):
+    out = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+@ray_tpu.remote
+def _sort_block(block, key):
+    return sorted(block, key=key)
+
+
+@ray_tpu.remote
+def _block_count(block):
+    return len(block)
+
+
+def _to_batch(block: list, batch_format: str):
+    if batch_format == "numpy":
+        if block and isinstance(block[0], dict):
+            return {k: np.asarray([r[k] for r in block]) for k in block[0]}
+        return np.asarray(block)
+    return block
+
+
+def _from_batch(batch) -> list:
+    if isinstance(batch, dict):
+        keys = list(batch)
+        n = len(batch[keys[0]])
+        return [{k: batch[k][i] for k in keys} for i in builtins.range(n)]
+    if isinstance(batch, np.ndarray):
+        return list(batch)
+    return list(batch)
+
+
+class Dataset:
+    def __init__(self, blocks: List[ObjectRef]):
+        self._blocks = blocks
+
+    # ------------------------------------------------------------ creation
+
+    @staticmethod
+    def from_items(items: List[Any], parallelism: int = 8) -> "Dataset":
+        items = list(items)
+        n_blocks = min(parallelism, max(1, len(items)))
+        blocks = []
+        per = (len(items) + n_blocks - 1) // n_blocks
+        for i in builtins.range(0, len(items), per):
+            blocks.append(ray_tpu.put(items[i : i + per]))
+        return Dataset(blocks)
+
+    @staticmethod
+    def range(n: int, parallelism: int = 8) -> "Dataset":
+        return Dataset.from_items(list(builtins.range(n)), parallelism)
+
+    @staticmethod
+    def from_numpy(arrays: Union[np.ndarray, List[np.ndarray]]) -> "Dataset":
+        if isinstance(arrays, np.ndarray):
+            arrays = [arrays]
+        return Dataset([ray_tpu.put(list(a)) for a in arrays])
+
+    # ---------------------------------------------------------- transforms
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return Dataset([_map_block.remote(fn, b) for b in self._blocks])
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_format: str = "numpy",
+        compute: Optional["ActorPoolStrategy"] = None,
+    ) -> "Dataset":
+        if compute is not None:
+            return compute._map_batches(self, fn, batch_format)
+        return Dataset([_map_batch.remote(fn, b, batch_format) for b in self._blocks])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return Dataset([_filter_block.remote(fn, b) for b in self._blocks])
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        return Dataset.from_items(rows, parallelism=num_blocks)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """All-to-all shuffle: split every block into N shards, then one
+        concat task per output block (the push-based shuffle shape,
+        reference: data/_internal/push_based_shuffle.py)."""
+        n = max(1, len(self._blocks))
+        rng_seed = seed if seed is not None else 0
+
+        @ray_tpu.remote(num_returns=n)
+        def split(block, salt):
+            rng = np.random.default_rng(rng_seed + salt)
+            idx = rng.permutation(len(block))
+            shards = [[] for _ in builtins.range(n)]
+            for j, i in enumerate(idx):
+                shards[j % n].append(block[i])
+            return tuple(shards) if n > 1 else shards[0]
+
+        shard_refs = [split.remote(b, salt) for salt, b in enumerate(self._blocks)]
+        if n == 1:
+            return Dataset([_concat_blocks.remote(*[r for r in shard_refs])])
+        out = []
+        for j in builtins.range(n):
+            out.append(_concat_blocks.remote(*[refs[j] for refs in shard_refs]))
+        return Dataset(out)
+
+    def sort(self, key: Optional[Callable] = None) -> "Dataset":
+        key = key or (lambda x: x)
+        rows = sorted(self.take_all(), key=key)
+        return Dataset.from_items(rows, parallelism=len(self._blocks))
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Equal-ish splits for Train ingest (reference: _internal/split.py)."""
+        rows = self.take_all()
+        per = (len(rows) + n - 1) // n
+        return [Dataset.from_items(rows[i * per : (i + 1) * per] or [], 1) for i in builtins.range(n)]
+
+    # ------------------------------------------------------------- actions
+
+    def count(self) -> int:
+        return sum(ray_tpu.get([_block_count.remote(b) for b in self._blocks], timeout=300))
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for b in self._blocks:
+            out.extend(ray_tpu.get(b, timeout=300))
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List[Any]:
+        out = []
+        for block in ray_tpu.get(list(self._blocks), timeout=600):
+            out.extend(block)
+        return out
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._blocks:
+            yield from ray_tpu.get(b, timeout=300)
+
+    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy") -> Iterator[Any]:
+        buf: List[Any] = []
+        for b in self._blocks:
+            buf.extend(ray_tpu.get(b, timeout=300))
+            while len(buf) >= batch_size:
+                yield _to_batch(buf[:batch_size], batch_format)
+                buf = buf[batch_size:]
+        if buf:
+            yield _to_batch(buf, batch_format)
+
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def schema(self):
+        first = self.take(1)
+        return type(first[0]).__name__ if first else None
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={len(self._blocks)})"
+
+
+class ActorPoolStrategy:
+    """Stateful transform pool (reference: compute.py:150 ActorPoolStrategy):
+    blocks are mapped through a fixed pool of actors holding fn state —
+    the shape jitted-model batch inference wants on TPU."""
+
+    def __init__(self, size: int = 2):
+        self.size = size
+
+    def _map_batches(self, ds: Dataset, fn, batch_format: str) -> Dataset:
+        class _MapActor:
+            def __init__(self):
+                import inspect
+
+                self.fn = fn() if inspect.isclass(fn) else fn
+
+            def apply(self, block, fmt):
+                batch = _to_batch(block, fmt)
+                return _from_batch(self.fn(batch))
+
+        actor_cls = ray_tpu.remote(_MapActor)
+        pool = [actor_cls.remote() for _ in builtins.range(self.size)]
+        out = []
+        for i, b in enumerate(ds._blocks):
+            out.append(pool[i % self.size].apply.remote(b, batch_format))
+        result = Dataset(out)
+        result._pool = pool  # keep actors alive while blocks are pending
+        return result
+
+
+def from_items(items, parallelism: int = 8) -> Dataset:
+    return Dataset.from_items(items, parallelism)
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset.range(n, parallelism)
+
+
+def from_numpy(arrays) -> Dataset:
+    return Dataset.from_numpy(arrays)

@@ -1,0 +1,6 @@
+from ray_tpu.experimental.state.api import (  # noqa: F401
+    list_actors,
+    list_nodes,
+    list_placement_groups,
+    list_tasks,
+)

@@ -1,0 +1,71 @@
+"""Cluster state API: `list actors/tasks/nodes/placement groups`.
+
+Analog of the reference's state API (reference:
+python/ray/experimental/state/api.py:724 list_actors, :947 list_tasks,
+:991 list_objects backed by the dashboard StateAggregator).  Served
+straight from the head's tables over the control protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ray_tpu._private.protocol import MsgType
+
+
+def _cw():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._require_connected()
+
+
+def list_actors() -> List[dict]:
+    reply = _cw().request(MsgType.LIST_ACTORS, {})
+    out = []
+    for a in reply["actors"]:
+        out.append(
+            {
+                "actor_id": a["actor_id"].hex(),
+                "state": a["state"],
+                "name": a["name"],
+                "namespace": a["namespace"],
+                "class_name": a["class_name"],
+                "node_id": a["node_id"].hex() if a["node_id"] else "",
+                "pid": a["pid"],
+            }
+        )
+    return out
+
+
+def list_tasks() -> List[dict]:
+    reply = _cw().request(MsgType.LIST_TASKS, {})
+    return [
+        {"task_id": t["task_id"].hex(), "state": t["state"], "name": t["name"]}
+        for t in reply["tasks"]
+    ]
+
+
+def list_nodes() -> List[dict]:
+    return [
+        {
+            "node_id": n["node_id"].hex(),
+            "alive": n["alive"],
+            "resources": n["resources"],
+            "available": n["available"],
+            "num_workers": n["num_workers"],
+        }
+        for n in _cw().list_nodes()
+    ]
+
+
+def list_placement_groups() -> List[dict]:
+    reply = _cw().request(MsgType.LIST_PGS, {})
+    return [
+        {
+            "placement_group_id": p["pg_id"].hex(),
+            "name": p["name"],
+            "state": p["state"],
+            "strategy": p["strategy"],
+        }
+        for p in reply["pgs"]
+    ]

@@ -85,34 +85,55 @@ class WorkerInfo:
 
 
 class NodeInfo:
+    """Node bookkeeping.  Resource accounting is delegated to the native
+    scheduling core (src/scheduler/scheduler.cc via core/native_scheduler.py
+    — fixed-point math, hybrid policy), the analog of the reference's C++
+    ClusterResourceManager (src/ray/raylet/scheduling/)."""
+
     __slots__ = (
         "node_id",
         "conn",
         "resources_total",
-        "resources_available",
         "store_path",
         "alive",
         "workers",
         "starting_workers",
         "labels",
         "address",
+        "_sched",
     )
 
-    def __init__(self, node_id: bytes, conn: Optional[Connection], resources: Dict[str, float], store_path: str):
+    def __init__(
+        self,
+        node_id: bytes,
+        conn: Optional[Connection],
+        resources: Dict[str, float],
+        store_path: str,
+        sched=None,
+    ):
         self.node_id = node_id
         self.conn = conn  # raylet connection (None for the head's own node)
         self.resources_total = dict(resources)
-        self.resources_available = dict(resources)
         self.store_path = store_path
         self.alive = True
         self.workers: Dict[bytes, WorkerInfo] = {}
         self.starting_workers = 0
         self.labels: Dict[str, str] = {}
         self.address = ""
+        self._sched = sched
+        if sched is not None:
+            sched.upsert_node(node_id, self.resources_total)
+
+    @property
+    def resources_available(self) -> Dict[str, float]:
+        avail = self._sched.available(self.node_id)
+        # only report resource types this node actually has
+        return {k: avail.get(k, 0.0) for k in self.resources_total}
 
     def can_fit(self, demand: Dict[str, float]) -> bool:
+        avail = self._sched.available(self.node_id)
         for k, v in demand.items():
-            if v > 0 and self.resources_available.get(k, 0.0) + 1e-9 < v:
+            if v > 0 and avail.get(k, 0.0) + 1e-9 < v:
                 return False
         return True
 
@@ -123,27 +144,16 @@ class NodeInfo:
         return True
 
     def acquire(self, demand: Dict[str, float]):
-        for k, v in demand.items():
-            if v > 0:
-                self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+        self._sched.acquire(self.node_id, demand, force=True)
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        return self._sched.acquire(self.node_id, demand, force=False)
 
     def release(self, demand: Dict[str, float]):
-        for k, v in demand.items():
-            if v > 0:
-                self.resources_available[k] = min(
-                    self.resources_available.get(k, 0.0) + v,
-                    self.resources_total.get(k, 0.0),
-                )
+        self._sched.release(self.node_id, demand)
 
     def utilization(self) -> float:
-        """Max over resources of used/total — the hybrid policy score input
-        (reference: scheduling/policy/hybrid_scheduling_policy.cc)."""
-        u = 0.0
-        for k, tot in self.resources_total.items():
-            if tot > 0:
-                used = tot - self.resources_available.get(k, 0.0)
-                u = max(u, used / tot)
-        return u
+        return self._sched.utilization(self.node_id)
 
 
 class ActorInfo:
@@ -227,6 +237,9 @@ class HeadServer:
         self.store_capacity = store_capacity or RayConfig.object_store_memory
         self._server: Optional[asyncio.AbstractServer] = None
 
+        from ray_tpu.core.native_scheduler import NativeScheduler
+
+        self.sched = NativeScheduler()
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.head_node_id = NodeID.from_random().binary()
         self._head_resources = resources or {}
@@ -269,7 +282,7 @@ class HeadServer:
         res.setdefault("CPU", float(os.cpu_count() or 4))
         res.setdefault("memory", 4.0 * (1 << 30))
         res.setdefault("object_store_memory", float(self.store_capacity))
-        node = NodeInfo(self.head_node_id, None, res, self.store_path)
+        node = NodeInfo(self.head_node_id, None, res, self.store_path, sched=self.sched)
         node.labels["node_type"] = "head"
         self.nodes[self.head_node_id] = node
         # create the shm store segment for the head node
@@ -360,7 +373,7 @@ class HeadServer:
 
     async def h_register_node(self, cid, conn, p):
         nid = p["node_id"]
-        node = NodeInfo(nid, conn, p["resources"], p["store_path"])
+        node = NodeInfo(nid, conn, p["resources"], p["store_path"], sched=self.sched)
         node.address = p.get("address", "")
         self.nodes[nid] = node
         self._conn_kind[cid] = "raylet"
@@ -412,6 +425,7 @@ class HeadServer:
                     pg.bundle_nodes[i] = None
                     pg.state = "RESCHEDULING"
         del self.nodes[nid]
+        self.sched.remove_node(nid)
         await self._publish("node", {"event": "dead", "node_id": nid})
         self._kick_scheduler()
 
@@ -1137,21 +1151,16 @@ class HeadServer:
             return None
         if spec.node_affinity:
             node = self.nodes.get(spec.node_affinity)
-            if node and node.alive and node.can_fit(res):
-                node.acquire(res)
+            if node and node.alive and node.try_acquire(res):
                 return node
             return None
-        feasible = [n for n in self.nodes.values() if n.alive and n.can_fit(res)]
-        if not feasible:
+        # decision + reservation in one native call (hybrid pack/spread)
+        nid = self.sched.pick_and_acquire(
+            res, RayConfig.scheduler_spread_threshold, prefer=self.head_node_id
+        )
+        if nid is None:
             return None
-        thresh = RayConfig.scheduler_spread_threshold
-        packing = [n for n in feasible if n.utilization() < thresh]
-        if packing:
-            node = max(packing, key=lambda n: (n.utilization(), n.node_id == self.head_node_id))
-        else:
-            node = min(feasible, key=lambda n: n.utilization())
-        node.acquire(res)
-        return node
+        return self.nodes.get(nid)
 
     async def _scheduler_loop(self):
         while not self._shutdown:

@@ -1,0 +1,1 @@
+from ray_tpu.job_submission.client import JobStatus, JobSubmissionClient  # noqa: F401

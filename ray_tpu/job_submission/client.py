@@ -1,0 +1,128 @@
+"""Job submission: run an entrypoint command against a live cluster.
+
+Analog of the reference's job API (reference: dashboard/modules/job/
+job_manager.py JobManager — supervisor actor per job, status + log
+tailing; SDK python/ray/job_submission/).  The supervisor actor spawns the
+entrypoint subprocess with RAY_TPU_ADDRESS pointed at the cluster so the
+job's ray_tpu.init(address="auto") attaches.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from typing import Dict, Optional
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobSupervisor:
+    """Detached actor owning one job's subprocess."""
+
+    def __init__(self, job_id: str, entrypoint: str, env: Optional[dict], address: str):
+        import os
+        import subprocess
+        import tempfile
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_path = tempfile.mktemp(prefix=f"ray_tpu_job_{job_id}_", suffix=".log")
+        penv = dict(os.environ)
+        penv.update(env or {})
+        penv["RAY_TPU_ADDRESS"] = address
+        self._logf = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, env=penv, stdout=self._logf, stderr=self._logf
+        )
+        self.stopped = False
+
+    def status(self) -> str:
+        rc = self.proc.poll()
+        if rc is None:
+            return JobStatus.RUNNING
+        if self.stopped:
+            return JobStatus.STOPPED
+        return JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+
+    def stop(self):
+        self.stopped = True
+        try:
+            self.proc.terminate()
+        except OSError:
+            pass
+        return True
+
+    def logs(self) -> str:
+        self._logf.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class JobSubmissionClient:
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        if not worker_mod.global_worker.connected:
+            ray_tpu.init(address=address)
+        self._address = worker_mod.global_worker.address
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        runtime_env: Optional[dict] = None,
+        job_id: Optional[str] = None,
+    ) -> str:
+        import ray_tpu
+
+        job_id = job_id or f"raytpu_job_{uuid.uuid4().hex[:8]}"
+        env = (runtime_env or {}).get("env_vars")
+        cls = ray_tpu.remote(_JobSupervisor)
+        cls.options(name=f"_job_{job_id}", lifetime="detached", num_cpus=0).remote(
+            job_id, entrypoint, env, self._address
+        )
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        import ray_tpu
+
+        return ray_tpu.get_actor(f"_job_{job_id}")
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        import ray_tpu
+
+        try:
+            sup = self._supervisor(job_id)
+        except ValueError:
+            return JobStatus.STOPPED
+        return JobStatus(ray_tpu.get(sup.status.remote(), timeout=30))
+
+    def get_job_logs(self, job_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._supervisor(job_id).logs.remote(), timeout=30)
+
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._supervisor(job_id).stop.remote(), timeout=30)
+
+    def wait_until_finish(self, job_id: str, timeout: float = 300) -> JobStatus:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

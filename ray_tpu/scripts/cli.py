@@ -1,0 +1,176 @@
+"""CLI: ray-tpu start/stop/status/submit/memory/timeline.
+
+Analog of the reference's scripts (reference: python/ray/scripts/
+scripts.py — start:532, stop:980, status, memory, timeline, submit:1466).
+Invoke as ``python -m ray_tpu.scripts.cli <cmd>`` (or the ray-tpu
+entrypoint when installed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def cmd_start(args):
+    if not args.head:
+        print("only --head start is supported in this round; workers join via raylet", file=sys.stderr)
+        return 1
+    res = {}
+    if args.num_cpus is not None:
+        res["CPU"] = args.num_cpus
+    if args.num_tpus is not None:
+        res["TPU"] = args.num_tpus
+    session_dir = f"/tmp/ray_tpu/cli_{int(time.time())}"
+    os.makedirs(session_dir, exist_ok=True)
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu.gcs.head_main",
+        "--host",
+        args.host,
+        "--port",
+        str(args.port),
+        "--session-dir",
+        session_dir,
+        "--resources",
+        json.dumps(res),
+    ]
+    logf = open(os.path.join(session_dir, "head.log"), "ab")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf, start_new_session=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith(b"PORT "):
+            port = int(line.split()[1])
+            with open("/tmp/ray_tpu/head_address", "w") as f:
+                f.write(f"{args.host}:{port}\n{proc.pid}\n")
+            print(f"head started at {args.host}:{port} (pid {proc.pid})")
+            print(f"connect with: ray_tpu.init(address='{args.host}:{port}')")
+            return 0
+        if proc.poll() is not None:
+            break
+    print("head failed to start", file=sys.stderr)
+    return 1
+
+
+def _read_address(args):
+    addr = getattr(args, "address", None)
+    if addr:
+        return addr
+    try:
+        with open("/tmp/ray_tpu/head_address") as f:
+            return f.read().splitlines()[0]
+    except OSError:
+        print("no running head found (missing /tmp/ray_tpu/head_address)", file=sys.stderr)
+        sys.exit(1)
+
+
+def cmd_stop(args):
+    try:
+        with open("/tmp/ray_tpu/head_address") as f:
+            lines = f.read().splitlines()
+        pid = int(lines[1])
+        os.kill(pid, 15)
+        os.remove("/tmp/ray_tpu/head_address")
+        print(f"stopped head (pid {pid})")
+        return 0
+    except (OSError, IndexError, ValueError) as e:
+        print(f"stop failed: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_read_address(args))
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("== cluster resources ==")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
+    print("== nodes ==")
+    for n in ray_tpu.nodes():
+        print(f"  {n['NodeID'][:12]} alive={n['Alive']} {n['Resources']}")
+    from ray_tpu.experimental.state import list_actors
+
+    actors = list_actors()
+    alive = sum(1 for a in actors if a["state"] == "ALIVE")
+    print(f"== actors == {alive} alive / {len(actors)} total")
+    return 0
+
+
+def cmd_memory(args):
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    ray_tpu.init(address=_read_address(args))
+    cw = worker_mod._require_connected()
+    store = cw.store
+    print(
+        f"object store: {store.used()}/{store.capacity()} bytes, "
+        f"{store.num_objects()} objects, {store.evictions()} evictions"
+    )
+    return 0
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(address=_read_address(args))
+    job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finish(job_id, timeout=args.timeout)
+        print(f"{job_id}: {status}")
+        print(client.get_job_logs(job_id))
+        return 0 if status == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_metrics(args):
+    import ray_tpu
+    from ray_tpu.util import metrics as m
+
+    ray_tpu.init(address=_read_address(args))
+    sys.stdout.write(m.prometheus_text())
+    return 0
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the head")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("memory", cmd_memory), ("metrics", cmd_metrics)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("submit", help="submit a job entrypoint command")
+    p.add_argument("--address", default=None)
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    args = parser.parse_args()
+    sys.exit(args.fn(args))
+
+
+if __name__ == "__main__":
+    main()

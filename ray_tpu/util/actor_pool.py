@@ -1,0 +1,59 @@
+"""ActorPool (analog: reference python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []  # submission queue when no idle actor
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef"""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+        else:
+            self._pending.append((fn, value))
+
+    def get_next(self, timeout=None):
+        import ray_tpu
+
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        refs = list(self._future_to_actor)
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        ref = ready[0]
+        actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            self.submit(fn, value)
+        return ray_tpu.get(ref)
+
+    def get_next_unordered(self, timeout=None):
+        return self.get_next(timeout)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        values = list(values)
+        for v in values:
+            self.submit(fn, v)
+        results = []
+        for _ in values:
+            results.append(self.get_next())
+        return results
+
+    def map_unordered(self, fn, values):
+        return self.map(fn, values)
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

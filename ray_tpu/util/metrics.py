@@ -1,0 +1,100 @@
+"""Application metrics: Counter/Gauge/Histogram.
+
+Analog of the reference's ray.util.metrics (reference:
+python/ray/util/metrics.py backed by the Cython Metric →  opencensus →
+per-node agent → Prometheus).  Values aggregate in the head KV under
+``metrics:*`` keys; the state API and CLI read them; a Prometheus-format
+dump is exposed via `prometheus_text()`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional, Tuple
+
+
+def _kv():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._require_connected()
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> str:
+    if not tags:
+        return ""
+    return ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = tags
+        return self
+
+    def _store(self, value: float, tags, mode: str):
+        tags = {**self._default_tags, **(tags or {})}
+        key = f"metrics:{self.name}:{_tag_key(tags)}"
+        cw = _kv()
+        old = cw.kv_get(key)
+        record = json.loads(old) if old else {"value": 0.0, "count": 0, "sum": 0.0}
+        if mode == "inc":
+            record["value"] += value
+        elif mode == "set":
+            record["value"] = value
+        else:  # observe
+            record["count"] += 1
+            record["sum"] += value
+            record["value"] = record["sum"] / record["count"]
+        record["ts"] = time.time()
+        record["description"] = self.description
+        cw.kv_put(key, json.dumps(record).encode())
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        self._store(value, tags, "inc")
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._store(value, tags, "set")
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries=None, tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = boundaries or []
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._store(value, tags, "observe")
+
+
+def read_all() -> Dict[str, dict]:
+    cw = _kv()
+    out = {}
+    for key in cw.kv_keys("metrics:"):
+        raw = cw.kv_get(key)
+        if raw:
+            out[key[len("metrics:") :]] = json.loads(raw)
+    return out
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format (the exporter surface of the
+    reference's metrics agent)."""
+    lines = []
+    for key, rec in sorted(read_all().items()):
+        name, _, tag_str = key.partition(":")
+        labels = ""
+        if tag_str:
+            pairs = [t.split("=", 1) for t in tag_str.split(",") if "=" in t]
+            labels = "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+        if rec.get("description"):
+            lines.append(f"# HELP {name} {rec['description']}")
+        lines.append(f"{name}{labels} {rec['value']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,83 @@
+"""Distributed Queue backed by an actor
+(analog: reference python/ray/util/queue.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+
+        self.maxsize = maxsize
+        self.items: List[Any] = []
+
+    async def put(self, item, timeout: Optional[float] = None):
+        import asyncio
+        import time
+
+        deadline = time.time() + timeout if timeout else None
+        while self.maxsize > 0 and len(self.items) >= self.maxsize:
+            if deadline and time.time() > deadline:
+                raise TimeoutError("queue full")
+            await asyncio.sleep(0.01)
+        self.items.append(item)
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+        import time
+
+        deadline = time.time() + timeout if timeout else None
+        while not self.items:
+            if deadline and time.time() > deadline:
+                raise TimeoutError("queue empty")
+            await asyncio.sleep(0.01)
+        return self.items.pop(0)
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        import ray_tpu
+
+        cls = ray_tpu.remote(_QueueActor)
+        opts = actor_options or {"num_cpus": 0}
+        self.actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        ray_tpu.get(self.actor.put.remote(item, timeout), timeout=(timeout or 300) + 10)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self.actor.get.remote(timeout), timeout=(timeout or 300) + 10)
+
+    def put_nowait(self, item):
+        return self.put(item, timeout=0.001)
+
+    def get_nowait(self):
+        return self.get(timeout=0.001)
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self.actor.empty.remote(), timeout=30)
+
+    def shutdown(self):
+        import ray_tpu
+
+        ray_tpu.kill(self.actor)

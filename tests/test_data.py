@@ -1,0 +1,75 @@
+"""Data library tests (reference tier: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(ray_cluster):
+    ds = rdata.range(100, parallelism=4)
+    assert ds.num_blocks() == 4
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_filter_pipeline(ray_cluster):
+    ds = rdata.range(20, parallelism=2).map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert sorted(ds.take_all()) == [0, 4, 8, 12, 16, 20, 24, 28, 32, 36]
+
+
+def test_map_batches_numpy(ray_cluster):
+    ds = rdata.from_numpy(np.arange(32, dtype=np.float32))
+    out = ds.map_batches(lambda arr: arr * 10, batch_format="numpy")
+    assert sorted(float(x) for x in out.take_all()) == [float(i * 10) for i in range(32)]
+
+
+def test_random_shuffle_preserves_rows(ray_cluster):
+    ds = rdata.range(50, parallelism=4)
+    shuffled = ds.random_shuffle(seed=7)
+    rows = shuffled.take_all()
+    assert sorted(rows) == list(range(50))
+    assert rows != list(range(50))
+
+
+def test_split_for_train_ingest(ray_cluster):
+    ds = rdata.range(30, parallelism=3)
+    shards = ds.split(3)
+    assert len(shards) == 3
+    total = []
+    for s in shards:
+        total.extend(s.take_all())
+    assert sorted(total) == list(range(30))
+
+
+def test_iter_batches(ray_cluster):
+    ds = rdata.range(25, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=10))
+    assert [len(b) for b in batches] == [10, 10, 5]
+    assert isinstance(batches[0], np.ndarray)
+
+
+def test_actor_pool_strategy(ray_cluster):
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Doubler:
+        def __call__(self, batch):
+            return batch * 2
+
+    ds = rdata.from_numpy(np.arange(16, dtype=np.float32))
+    out = ds.map_batches(Doubler, compute=ActorPoolStrategy(size=2))
+    assert sorted(float(x) for x in out.take_all()) == [float(2 * i) for i in range(16)]
+
+
+def test_sort_and_repartition(ray_cluster):
+    ds = rdata.from_items([5, 3, 1, 4, 2], parallelism=2)
+    assert ds.sort().take_all() == [1, 2, 3, 4, 5]
+    assert ds.repartition(5).num_blocks() == 5

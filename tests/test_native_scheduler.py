@@ -1,0 +1,75 @@
+"""Native scheduling core unit tests (analog of the reference's
+scheduling_policy_test.cc / cluster_resource_scheduler_test.cc tier)."""
+
+import pytest
+
+from ray_tpu.core.native_scheduler import NativeScheduler
+
+
+def test_acquire_release_roundtrip():
+    s = NativeScheduler()
+    s.upsert_node(b"n1" * 8, {"CPU": 4.0, "TPU": 1.0})
+    nid = b"n1" * 8
+    assert s.acquire(nid, {"CPU": 2.0})
+    assert s.available(nid)["CPU"] == 2.0
+    assert not s.acquire(nid, {"CPU": 3.0})  # insufficient
+    s.release(nid, {"CPU": 2.0})
+    assert s.available(nid)["CPU"] == 4.0
+    # release clamps at total
+    s.release(nid, {"CPU": 10.0})
+    assert s.available(nid)["CPU"] == 4.0
+
+
+def test_fractional_fixed_point():
+    s = NativeScheduler()
+    nid = b"x" * 16
+    s.upsert_node(nid, {"CPU": 1.0})
+    for _ in range(10):
+        assert s.acquire(nid, {"CPU": 0.1})
+    assert not s.acquire(nid, {"CPU": 0.1})
+    assert abs(s.available(nid)["CPU"]) < 1e-9
+
+
+def test_hybrid_policy_packs_then_spreads():
+    s = NativeScheduler()
+    a, b = b"a" * 16, b"b" * 16
+    s.upsert_node(a, {"CPU": 10.0})
+    s.upsert_node(b, {"CPU": 10.0})
+    # seed utilization: a at 30%
+    assert s.acquire(a, {"CPU": 3.0})
+    # below the 0.5 threshold → pack onto the more utilized feasible node (a)
+    picked = s.pick_and_acquire({"CPU": 1.0}, spread_threshold=0.5)
+    assert picked == a
+    # push a over the threshold
+    assert s.acquire(a, {"CPU": 2.0})  # a now at 60%
+    picked = s.pick_and_acquire({"CPU": 1.0}, spread_threshold=0.5)
+    assert picked == b  # spread to least utilized
+
+
+def test_pick_respects_feasibility():
+    s = NativeScheduler()
+    a = b"a" * 16
+    s.upsert_node(a, {"CPU": 2.0})
+    assert s.pick_and_acquire({"CPU": 4.0}, 0.5) is None
+    assert s.feasible({"CPU": 4.0}) is False
+    assert s.feasible({"CPU": 2.0}) is True
+
+
+def test_remove_node_excluded():
+    s = NativeScheduler()
+    a, b = b"a" * 16, b"b" * 16
+    s.upsert_node(a, {"CPU": 4.0})
+    s.upsert_node(b, {"CPU": 4.0})
+    s.remove_node(a)
+    for _ in range(4):
+        assert s.pick_and_acquire({"CPU": 1.0}, 0.5) == b
+    assert s.pick_and_acquire({"CPU": 1.0}, 0.5) is None
+
+
+def test_custom_resources():
+    s = NativeScheduler()
+    a, b = b"a" * 16, b"b" * 16
+    s.upsert_node(a, {"CPU": 4.0})
+    s.upsert_node(b, {"CPU": 4.0, "TPU": 8.0})
+    assert s.pick_and_acquire({"CPU": 1.0, "TPU": 4.0}, 0.5) == b
+    assert s.utilization(b) == 0.5  # TPU is the max-utilized dimension

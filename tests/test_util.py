@@ -1,0 +1,88 @@
+"""util integrations + state API + metrics + job submission tests."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_actor_pool(ray_cluster):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    results = pool.map(lambda a, v: a.double.remote(v), range(8))
+    assert sorted(results) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_queue(ray_cluster):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+    q.put({"a": 1})
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == {"a": 1}
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_state_api(ray_cluster):
+    from ray_tpu.experimental.state import list_actors, list_nodes
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return 1
+
+    p = Pinger.options(name="state_test_actor").remote()
+    ray_tpu.get(p.ping.remote(), timeout=60)
+    actors = list_actors()
+    assert any(a["name"] == "state_test_actor" and a["state"] == "ALIVE" for a in actors)
+    nodes = list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+
+def test_metrics(ray_cluster):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", description="reqs")
+    c.inc()
+    c.inc(2.0)
+    g = metrics.Gauge("test_depth")
+    g.set(7.0, tags={"shard": "a"})
+    data = metrics.read_all()
+    assert any(k.startswith("test_requests") and v["value"] == 3.0 for k, v in data.items())
+    text = metrics.prometheus_text()
+    assert "test_requests 3.0" in text
+    assert 'test_depth{shard="a"} 7.0' in text
+
+
+def test_job_submission(ray_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="python -c 'print(\"job ran ok\")'")
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(job_id)
+
+
+def test_job_failure_reported(ray_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finish(job_id, timeout=60) == JobStatus.FAILED
