@@ -39,6 +39,21 @@ def available_resources():
     return _w._require_connected().available_resources()
 
 
+def timeline(filename=None):
+    """Chrome-trace events of recent task executions (reference:
+    ray.timeline / `ray timeline`).  Load the file in chrome://tracing."""
+    import json as _json
+
+    from ray_tpu._private import worker as _w
+    from ray_tpu._private.protocol import MsgType as _M
+
+    events = _w._require_connected().request(_M.TIMELINE, {})["events"]
+    if filename:
+        with open(filename, "w") as f:
+            _json.dump(events, f)
+    return events
+
+
 def nodes():
     from ray_tpu._private import worker as _w
 

@@ -1,0 +1,88 @@
+"""Core microbenchmarks: tasks/s, actor calls/s, put/get throughput.
+
+Analog of the reference's microbenchmark suite (reference:
+python/ray/_private/ray_perf.py:93 main — the numbers CI tracks per
+release, release/release_tests.yaml:3411).  Run:
+``python -m ray_tpu._private.ray_perf``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, multiplier=1, results=None):
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < 2.0:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name}: {rate:,.1f} /s")
+    if results is not None:
+        results[name] = rate
+    return rate
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results = {}
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+    # warm the pool
+    ray_tpu.get([tiny.remote() for _ in range(8)], timeout=120)
+
+    timeit(
+        "single client tasks sync",
+        lambda: ray_tpu.get(tiny.remote(), timeout=60),
+        results=results,
+    )
+    timeit(
+        "tasks async batch 100",
+        lambda: ray_tpu.get([tiny.remote() for _ in range(100)], timeout=120),
+        multiplier=100,
+        results=results,
+    )
+    actor = Actor.remote()
+    ray_tpu.get(actor.ping.remote(), timeout=60)
+    timeit(
+        "actor calls sync",
+        lambda: ray_tpu.get(actor.ping.remote(), timeout=60),
+        results=results,
+    )
+    timeit(
+        "actor calls async batch 100",
+        lambda: ray_tpu.get([actor.ping.remote() for _ in range(100)], timeout=120),
+        multiplier=100,
+        results=results,
+    )
+    small = np.zeros(1024, np.uint8)
+    timeit("put small (1KB)", lambda: ray_tpu.put(small), results=results)
+    big = np.zeros(8 * 1024 * 1024, np.uint8)
+    timeit(
+        "put+get 8MB roundtrip",
+        lambda: ray_tpu.get(ray_tpu.put(big)),
+        results=results,
+    )
+    print(json.dumps({k: round(v, 1) for k, v in results.items()}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Test utilities: chaos injection.
+
+Analog of the reference's test_utils node killer (reference:
+python/ray/_private/test_utils.py:1106 get_and_run_node_killer — a
+detached actor that kills random raylets on an interval, driving the
+chaos suite python/ray/tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class WorkerKiller:
+    """Driver-side chaos: kill random worker processes on an interval.
+
+    (Worker-granularity version of the reference's NodeKillerActor —
+    node-granularity chaos goes through Cluster.remove_node.)
+    """
+
+    def __init__(self, interval_s: float = 1.0, seed: int = 0):
+        self.interval_s = interval_s
+        self.rng = random.Random(seed)
+        self.killed_pids: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _worker_pids(self) -> List[int]:
+        from ray_tpu.experimental.state import list_actors
+
+        import subprocess
+
+        out = subprocess.run(
+            ["pgrep", "-f", "ray_tpu.core.worker_main"], capture_output=True, text=True
+        )
+        return [int(p) for p in out.stdout.split()]
+
+    def _loop(self):
+        import os
+        import signal
+
+        while not self._stop.is_set():
+            time.sleep(self.interval_s)
+            pids = self._worker_pids()
+            if not pids:
+                continue
+            victim = self.rng.choice(pids)
+            try:
+                os.kill(victim, signal.SIGKILL)
+                self.killed_pids.append(victim)
+            except OSError:
+                pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[int]:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        return self.killed_pids

@@ -1,0 +1,2 @@
+from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeProvider  # noqa: F401
+from ray_tpu.autoscaler.fake_provider import FakeMultiNodeProvider  # noqa: F401

@@ -1,0 +1,142 @@
+"""Autoscaler: demand-driven node lifecycle.
+
+Analog of the reference's autoscaler (reference: python/ray/autoscaler/
+_private/autoscaler.py StandardAutoscaler + resource_demand_scheduler.py
+bin-packing + node_provider.py plugin ABC + monitor.py loop).  Reads
+pending-task demand from the head, bin-packs it against node types, and
+asks the provider for nodes; reaps idle nodes after idle_timeout.
+
+TPU specifics live in node types: a type's resources can carry
+``{"TPU": 4}`` and provider-specific slice topology labels; STRICT_PACK
+placement-group demand maps to one node of a slice-sized type.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Plugin ABC (reference: autoscaler/node_provider.py)."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_handle: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        provider: NodeProvider,
+        node_types: Dict[str, Dict[str, Any]],
+        *,
+        max_workers: int = 8,
+        idle_timeout_s: float = 60.0,
+    ):
+        self.provider = provider
+        self.node_types = node_types  # name -> {"resources": {...}, "max_workers": n}
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.launched: Dict[str, str] = {}  # handle -> node_type
+        self._idle_since: Dict[str, float] = {}
+
+    def _pending_demand(self) -> List[Dict[str, float]]:
+        """Resource demands of queued (unplaceable) tasks from the head."""
+        from ray_tpu._private.protocol import MsgType
+        from ray_tpu._private import worker as worker_mod
+
+        cw = worker_mod._require_connected()
+        reply = cw.request(MsgType.LIST_TASKS, {})
+        return [
+            t.get("resources", {"CPU": 1.0})
+            for t in reply["tasks"]
+            if t["state"] == "QUEUED"
+        ]
+
+    def _fits(self, resources: Dict[str, float], demand: Dict[str, float]) -> bool:
+        return all(resources.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass: bin-pack queued demand onto hypothetical new
+        nodes, launch what's missing, reap long-idle nodes.  Returns the
+        launch decision per node type (for tests/observability)."""
+        demands = self._pending_demand()
+        to_launch: Dict[str, int] = {}
+        if demands:
+            # greedy first-fit-decreasing over node types (reference:
+            # resource_demand_scheduler.get_nodes_for)
+            bins: List[Dict[str, float]] = []
+            bin_types: List[str] = []
+            for demand in sorted(demands, key=lambda d: -sum(d.values())):
+                placed = False
+                for b in bins:
+                    if self._fits(b, demand):
+                        for k, v in demand.items():
+                            b[k] = b.get(k, 0.0) - v
+                        placed = True
+                        break
+                if placed:
+                    continue
+                for type_name, spec in self.node_types.items():
+                    if self._fits(spec["resources"], demand):
+                        remaining = dict(spec["resources"])
+                        for k, v in demand.items():
+                            remaining[k] -= v
+                        bins.append(remaining)
+                        bin_types.append(type_name)
+                        break
+            for t in bin_types:
+                to_launch[t] = to_launch.get(t, 0) + 1
+        # clamp to max_workers
+        budget = self.max_workers - len(self.launched)
+        for type_name in list(to_launch):
+            take = min(to_launch[type_name], max(budget, 0))
+            to_launch[type_name] = take
+            budget -= take
+            for _ in range(take):
+                handle = self.provider.create_node(
+                    type_name, self.node_types[type_name]["resources"]
+                )
+                self.launched[handle] = type_name
+        self._reap_idle()
+        return {k: v for k, v in to_launch.items() if v}
+
+    def _reap_idle(self):
+        """Terminate nodes with no busy workers for idle_timeout_s."""
+        import ray_tpu
+
+        try:
+            nodes = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        except Exception:
+            return
+        now = time.time()
+        for handle in list(self.launched):
+            info = nodes.get(handle)
+            busy = info is not None and any(
+                v < info["Resources"].get(k, 0.0)
+                for k, v in info["Available"].items()
+            )
+            if busy or info is None:
+                self._idle_since.pop(handle, None)
+                continue
+            first_idle = self._idle_since.setdefault(handle, now)
+            if now - first_idle > self.idle_timeout_s:
+                self.provider.terminate_node(handle)
+                del self.launched[handle]
+                self._idle_since.pop(handle, None)
+
+    def run_loop(self, interval_s: float = 5.0, stop_event=None):
+        """The monitor process loop (reference: _private/monitor.py)."""
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.update()
+            except Exception:
+                pass
+            time.sleep(interval_s)

@@ -1,0 +1,67 @@
+"""FakeMultiNodeProvider: fake cloud nodes as local raylet processes.
+
+Analog of the reference's test provider (reference: python/ray/autoscaler/
+_private/fake_multi_node/node_provider.py — fake nodes as local
+processes, the backbone of autoscaler CI tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+from ray_tpu.autoscaler.autoscaler import NodeProvider
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    def __init__(self, head_address: str, session_dir: str):
+        self.head_address = head_address
+        self.session_dir = session_dir
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        res = dict(resources)
+        res.setdefault("memory", 4.0 * (1 << 30))
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.raylet.raylet_main",
+            "--head",
+            self.head_address,
+            "--resources",
+            json.dumps(res),
+            "--session-dir",
+            self.session_dir,
+        ]
+        logf = open(os.path.join(self.session_dir, "autoscaled.log"), "ab")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith(b"NODE "):
+                node_id = line.split()[1].decode()
+                self._procs[node_id] = proc
+                return node_id
+            if proc.poll() is not None:
+                break
+        raise RuntimeError("fake node failed to start")
+
+    def terminate_node(self, node_handle: str) -> None:
+        proc = self._procs.pop(node_handle, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [h for h, p in self._procs.items() if p.poll() is None]
+
+    def shutdown(self):
+        for h in list(self._procs):
+            self.terminate_node(h)

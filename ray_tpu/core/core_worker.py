@@ -97,7 +97,8 @@ class _EventLoopThread:
         def _halt():
             for task in asyncio.all_tasks(self.loop):
                 task.cancel()
-            self.loop.stop()
+            # stop on the NEXT tick so cancellations actually unwind first
+            self.loop.call_soon(self.loop.stop)
 
         self.loop.call_soon_threadsafe(_halt)
         self._thread.join(timeout=5)
@@ -573,7 +574,15 @@ class CoreWorker:
         self.attach_store(reply["store_path"])
         return reply
 
-    def task_done(self, task_id: bytes, sealed: List[bytes], error: Optional[str], stored_error: bool):
+    def task_done(
+        self,
+        task_id: bytes,
+        sealed: List[bytes],
+        error: Optional[str],
+        stored_error: bool,
+        exec_start: float = 0.0,
+        exec_end: float = 0.0,
+    ):
         self.io.call(
             self.conn.send(
                 MsgType.TASK_DONE,
@@ -582,6 +591,8 @@ class CoreWorker:
                     "sealed": sealed,
                     "error": error,
                     "stored_error": stored_error,
+                    "exec_start": exec_start,
+                    "exec_end": exec_end,
                 },
             )
         )

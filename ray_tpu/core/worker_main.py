@@ -74,9 +74,12 @@ class WorkerRuntime:
     # ------------------------------------------------------------ execution
 
     def _execute_guarded(self, spec: TaskSpec):
+        import time as _time
+
         sealed: List[bytes] = []
         error: Optional[str] = None
         stored_error = False
+        exec_start = _time.time()
         try:
             if spec.task_id in self.cancelled:
                 raise RayTaskError(
@@ -107,13 +110,38 @@ class WorkerRuntime:
         finally:
             self.cw.current_task_id = None
         try:
-            self.cw.task_done(spec.task_id, sealed, error, stored_error)
+            self.cw.task_done(
+                spec.task_id,
+                sealed,
+                error,
+                stored_error,
+                exec_start=exec_start,
+                exec_end=_time.time(),
+            )
         except Exception:
             traceback.print_exc(file=sys.stderr)
             os._exit(1)  # lost the head: die, the head treats it as worker death
 
+    def _apply_runtime_env(self, spec: TaskSpec):
+        """env_vars + working_dir (reference: _private/runtime_env/ —
+        theirs sets up dedicated workers via the agent; here the worker
+        applies the env in-process before execution; conda/pip isolation
+        is out of scope on a fixed TPU-VM image and raises)."""
+        renv = spec.runtime_env or {}
+        unsupported = set(renv) - {"env_vars", "working_dir"}
+        if unsupported:
+            raise ValueError(f"unsupported runtime_env keys: {sorted(unsupported)}")
+        for k, v in (renv.get("env_vars") or {}).items():
+            os.environ[str(k)] = str(v)
+        wd = renv.get("working_dir")
+        if wd:
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)
+
     def _execute(self, spec: TaskSpec):
         self.cw.current_task_id = spec.task_id
+        self._apply_runtime_env(spec)
         args, kwargs = self.cw.decode_args(spec.args)
         if spec.task_type == NORMAL_TASK:
             fn = self.cw.fetch_function(spec.function_id)

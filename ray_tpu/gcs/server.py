@@ -262,6 +262,11 @@ class HeadServer:
         self.task_queue: List[TaskEntry] = []
         self.tasks: Dict[bytes, TaskEntry] = {}  # leased/running by task id
         self.finished_task_count = 0
+        # rolling task-execution event log for `ray-tpu timeline` (analog:
+        # reference core_worker/profiling.cc → GCS → chrome trace)
+        from collections import deque
+
+        self.timeline: "deque" = deque(maxlen=10000)
 
         self._conn_seq = 0
         self._conns: Dict[int, Connection] = {}
@@ -699,6 +704,19 @@ class HeadServer:
         if w is not None:
             w.running_tasks.discard(tid)
         self.finished_task_count += 1
+        if p.get("exec_end"):
+            entry_for_tl = entry or self.tasks.get(tid)
+            self.timeline.append(
+                {
+                    "name": (entry_for_tl.spec.function_name or entry_for_tl.spec.method_name)
+                    if entry_for_tl
+                    else "task",
+                    "pid": w.pid if w else 0,
+                    "ts": p.get("exec_start", 0.0),
+                    "dur": p["exec_end"] - p.get("exec_start", p["exec_end"]),
+                    "error": bool(p.get("error")),
+                }
+            )
         if entry is not None:
             self._unpin_args(entry.spec)
             spec = entry.spec
@@ -1088,11 +1106,37 @@ class HeadServer:
     async def h_list_tasks(self, cid, conn, p):
         out = []
         for e in self.task_queue:
-            out.append({"task_id": e.spec.task_id, "state": "QUEUED", "name": e.spec.function_name})
+            out.append(
+                {
+                    "task_id": e.spec.task_id,
+                    "state": "QUEUED",
+                    "name": e.spec.function_name,
+                    "resources": self._task_resources(e.spec),
+                }
+            )
         for e in self.tasks.values():
             if e.state != "QUEUED":
                 out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
         return {"tasks": out, "finished": self.finished_task_count}
+
+    async def h_timeline(self, cid, conn, p):
+        """Chrome-trace events of recent task executions
+        (reference: `ray timeline` scripts.py → profile table dump)."""
+        events = []
+        for e in self.timeline:
+            events.append(
+                {
+                    "name": e["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": e["ts"] * 1e6,
+                    "dur": e["dur"] * 1e6,
+                    "pid": e["pid"],
+                    "tid": e["pid"],
+                    "args": {"error": e["error"]},
+                }
+            )
+        return {"events": events}
 
     async def h_drain_node(self, cid, conn, p):
         nid = p["node_id"]
@@ -1345,4 +1389,5 @@ HeadServer._HANDLERS = {
     MsgType.AVAILABLE_RESOURCES: HeadServer.h_available_resources,
     MsgType.LIST_NODES: HeadServer.h_list_nodes,
     MsgType.LIST_TASKS: HeadServer.h_list_tasks,
+    MsgType.TIMELINE: HeadServer.h_timeline,
 }

@@ -1,0 +1,94 @@
+"""Expert parallelism: MoE FFN with all-to-all dispatch over the `ep` axis.
+
+A capability absent from the reference (SURVEY §2.4 "Expert parallel
+(EP/MoE): absent") — built the TPU way: experts shard over the `ep` mesh
+axis, tokens route to experts via `lax.all_to_all` (one ICI all-to-all
+each way), top-1 switch routing with capacity dropping (Switch
+Transformer; see PAPERS.md).
+
+Per-device shapes under shard_map: tokens [B_local, S, E]; each device
+hosts n_experts/ep_size experts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(
+    x: jax.Array,  # [tokens_local, E] per device
+    router_w: jax.Array,  # [E, n_experts]
+    expert_in: jax.Array,  # [experts_local, E, H]
+    expert_out: jax.Array,  # [experts_local, H, E]
+    *,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """Top-1 routed expert FFN.  Runs inside shard_map over `axis_name`."""
+    ep = lax.psum(1, axis_name)
+    n_tokens, E = x.shape
+    experts_local = expert_in.shape[0]
+    n_experts = ep * experts_local
+    capacity = max(1, int(capacity_factor * n_tokens / n_experts))
+
+    logits = x @ router_w  # [T, n_experts]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    # position of each token within its expert's queue; drop beyond capacity
+    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [T, X]
+    pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot  # [T, X]
+    pos = pos_in_expert.max(axis=1)  # [T]
+    keep = pos < capacity
+
+    # dispatch buffer: [n_experts, capacity, E]
+    dispatch = jnp.zeros((n_experts, capacity, E), x.dtype)
+    dispatch = dispatch.at[expert_idx, jnp.where(keep, pos, 0)].add(
+        jnp.where(keep[:, None], x, 0.0)
+    )
+    # all-to-all: expert dim split across devices, each device gets its
+    # experts' tokens from every peer → [ep, experts_local, capacity, E]
+    shaped = dispatch.reshape(ep, experts_local, capacity, E)
+    received = lax.all_to_all(shaped, axis_name, split_axis=0, concat_axis=0)
+    # [ep(peer), experts_local, capacity, E] → per expert: [ep*capacity, E]
+    tokens_per_expert = received.transpose(1, 0, 2, 3).reshape(
+        experts_local, ep * capacity, E
+    )
+
+    # expert FFN (batched over local experts — one MXU matmul pair)
+    h = jax.nn.gelu(jnp.einsum("xte,xeh->xth", tokens_per_expert, expert_in))
+    y = jnp.einsum("xth,xhe->xte", h, expert_out)
+
+    # route back: inverse all-to-all
+    y = y.reshape(experts_local, ep, capacity, E).transpose(1, 0, 2, 3)
+    returned = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    combined = returned.reshape(n_experts, capacity, E)
+
+    out = combined[expert_idx, jnp.where(keep, pos, 0)]
+    out = jnp.where(keep[:, None], out * gate[:, None], 0.0)
+    return out
+
+
+def make_moe_ffn(mesh, *, axis_name: str = "ep", capacity_factor: float = 1.25):
+    """shard_map wrapper: tokens sharded over `ep` (data-style), experts
+    sharded over `ep` (their leading dim)."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(moe_ffn, axis_name=axis_name, capacity_factor=capacity_factor)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(None, None), P(axis_name, None, None), P(axis_name, None, None)),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )

@@ -1,0 +1,75 @@
+"""Ulysses sequence parallelism: head-sharded attention via all-to-all.
+
+The second SP strategy from SURVEY §5: instead of rotating K/V around a
+ring (ring_attention.py), re-shard [seq-sharded, all heads] →
+[all seq, head-sharded] with one all-to-all, run full attention per head
+group, and all-to-all back (DeepSpeed-Ulysses; see PAPERS.md).  Cheaper in
+latency than the ring for moderate sequence lengths (2 collectives total
+instead of n-1 rotations); requires num_heads % sp == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _attention(q, k, v, causal: bool):
+    B, S, H, D = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Per-device shapes in: [B, S_local, H, D] (seq sharded).  Internally
+    re-shards to [B, S_full, H_local, D] (heads sharded), attends, and
+    re-shards back."""
+    sp = lax.psum(1, axis_name)
+    B, s_local, H, D = q.shape
+    assert H % sp == 0, f"num_heads {H} must divide sp {sp}"
+
+    def to_heads(x):
+        # [B, s_local, H, D] -> [sp, B, s_local, H/sp, D] -> a2a over seq
+        parts = x.reshape(B, s_local, sp, H // sp, D).transpose(2, 0, 1, 3, 4)
+        out = lax.all_to_all(parts, axis_name, split_axis=0, concat_axis=0)
+        # [sp(seq chunks), B, s_local, H/sp, D] -> [B, S_full, H/sp, D]
+        return out.transpose(1, 0, 2, 3, 4).reshape(B, sp * s_local, H // sp, D)
+
+    def to_seq(x):
+        # inverse of to_heads
+        parts = x.reshape(B, sp, s_local, H // sp, D).transpose(1, 0, 2, 3, 4)
+        out = lax.all_to_all(parts, axis_name, split_axis=0, concat_axis=0)
+        # [sp(head groups), B, s_local, H/sp, D] -> [B, s_local, H, D]
+        return out.transpose(1, 2, 0, 3, 4).reshape(B, s_local, H, D)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = _attention(qh, kh, vh, causal)
+    return to_seq(out)
+
+
+def make_ulysses_attention(mesh, *, causal: bool = True, axis_name: str = "sp"):
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal)
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )

@@ -1,0 +1,1 @@
+from ray_tpu.workflow.api import get_status, resume, run, run_async, step  # noqa: F401

@@ -1,0 +1,135 @@
+"""Workflow: durable task DAGs with storage-backed resume.
+
+Analog of the reference's ray.workflow (reference: python/ray/workflow/
+api.py run/resume, task_executor.py, storage/ — every step's result is
+persisted so a crashed workflow resumes from completed steps).
+
+Steps are normal remote tasks; results checkpoint to a filesystem store
+keyed by (workflow_id, step_name).  `resume` re-runs the DAG — steps whose
+checkpoint exists return it without executing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+STORAGE_ENV = "RAY_TPU_WORKFLOW_STORAGE"
+_DEFAULT_STORAGE = "/tmp/ray_tpu/workflows"
+
+
+def _storage_dir() -> str:
+    return os.environ.get(STORAGE_ENV, _DEFAULT_STORAGE)
+
+
+class WorkflowStep:
+    """A node in the DAG: fn + upstream steps/values."""
+
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict, name: Optional[str] = None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or fn.__name__
+
+    def options(self, name: Optional[str] = None, **_):
+        self.name = name or self.name
+        return self
+
+    def _step_key(self, path: str) -> str:
+        # stable identity: name + position in the DAG walk
+        return hashlib.sha1(path.encode()).hexdigest()[:16]
+
+
+def step(fn: Callable) -> Callable:
+    """@workflow.step decorator: calling the function builds a DAG node."""
+
+    def bind(*args, **kwargs) -> WorkflowStep:
+        return WorkflowStep(fn, args, kwargs)
+
+    bind.step = bind
+    bind.__name__ = fn.__name__
+    return bind
+
+
+def _ckpt_path(workflow_id: str, step_key: str) -> str:
+    return os.path.join(_storage_dir(), workflow_id, f"{step_key}.pkl")
+
+
+def _execute(node: Any, workflow_id: str, path: str) -> Any:
+    if not isinstance(node, WorkflowStep):
+        return node
+    key = node._step_key(path)
+    ckpt = _ckpt_path(workflow_id, key)
+    if os.path.exists(ckpt):
+        with open(ckpt, "rb") as f:
+            return pickle.load(f)
+    # resolve upstream steps depth-first (sequential; parallel fanout via
+    # sibling steps resolving to independent tasks would go through wait)
+    args = [
+        _execute(a, workflow_id, f"{path}/arg{i}:{getattr(a, 'name', '')}")
+        for i, a in enumerate(node.args)
+    ]
+    kwargs = {
+        k: _execute(v, workflow_id, f"{path}/kw_{k}:{getattr(v, 'name', '')}")
+        for k, v in node.kwargs.items()
+    }
+    import ray_tpu
+
+    remote_fn = ray_tpu.remote(node.fn)
+    result = ray_tpu.get(remote_fn.remote(*args, **kwargs), timeout=600)
+    os.makedirs(os.path.dirname(ckpt), exist_ok=True)
+    tmp = ckpt + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, ckpt)
+    return result
+
+
+def run(dag: WorkflowStep, workflow_id: Optional[str] = None) -> Any:
+    """Execute to completion, persisting each step
+    (reference: workflow.run api.py)."""
+    import uuid
+
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:8]}"
+    wf_dir = os.path.join(_storage_dir(), workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    with open(os.path.join(wf_dir, "STATUS"), "w") as f:
+        f.write("RUNNING")
+    try:
+        result = _execute(dag, workflow_id, dag.name)
+        with open(os.path.join(wf_dir, "STATUS"), "w") as f:
+            f.write("SUCCESSFUL")
+        return result
+    except BaseException:
+        with open(os.path.join(wf_dir, "STATUS"), "w") as f:
+            f.write("FAILED")
+        raise
+
+
+def run_async(dag: WorkflowStep, workflow_id: Optional[str] = None):
+    import threading
+
+    holder = {}
+
+    def _run():
+        holder["result"] = run(dag, workflow_id)
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    holder["thread"] = t
+    return holder
+
+
+def resume(workflow_id: str, dag: WorkflowStep) -> Any:
+    """Re-run the DAG; completed steps short-circuit from storage."""
+    return run(dag, workflow_id=workflow_id)
+
+
+def get_status(workflow_id: str) -> str:
+    try:
+        with open(os.path.join(_storage_dir(), workflow_id, "STATUS")) as f:
+            return f.read().strip()
+    except OSError:
+        return "NOT_FOUND"

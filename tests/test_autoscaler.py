@@ -1,0 +1,54 @@
+"""Autoscaler tests against the fake provider (reference tier:
+python/ray/tests/test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, FakeMultiNodeProvider
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    yield c
+    c.shutdown()
+
+
+def test_scales_up_for_queued_demand(cluster):
+    ray_tpu.init(address=cluster.address)
+    provider = FakeMultiNodeProvider(cluster.address, cluster.session_dir)
+    autoscaler = Autoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 4.0, "bonus": 4.0}}},
+        max_workers=2,
+        idle_timeout_s=9999,
+    )
+
+    @ray_tpu.remote(resources={"bonus": 1.0})
+    def needs_bonus():
+        return 1
+
+    try:
+        refs = [needs_bonus.remote() for _ in range(3)]
+        time.sleep(1.0)  # let tasks queue (head has no 'bonus' resource)
+        launched = autoscaler.update()
+        assert launched.get("cpu_worker", 0) >= 1
+        assert ray_tpu.get(refs, timeout=180) == [1, 1, 1]
+    finally:
+        provider.shutdown()
+
+
+def test_no_scale_when_idle(cluster):
+    ray_tpu.init(address=cluster.address)
+    provider = FakeMultiNodeProvider(cluster.address, cluster.session_dir)
+    autoscaler = Autoscaler(
+        provider, node_types={"cpu_worker": {"resources": {"CPU": 4.0}}}, max_workers=2
+    )
+    try:
+        assert autoscaler.update() == {}
+        assert provider.non_terminated_nodes() == []
+    finally:
+        provider.shutdown()

@@ -1,0 +1,84 @@
+"""Workflow tests: durable DAGs + resume (reference tier:
+python/ray/workflow/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def ray_cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_dag_runs(ray_cluster):
+    @workflow.step
+    def one():
+        return 1
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    result = workflow.run(add(one(), 10))
+    assert result == 11
+
+
+def test_resume_skips_completed_steps(ray_cluster, tmp_path):
+    marker = tmp_path / "side_effects"
+
+    @workflow.step
+    def expensive():
+        with open(marker, "a") as f:
+            f.write("x")
+        return 5
+
+    @workflow.step
+    def flaky(x, should_fail_file):
+        if os.path.exists(should_fail_file):
+            raise RuntimeError("injected failure")
+        return x * 2
+
+    fail_flag = str(tmp_path / "fail")
+    open(fail_flag, "w").close()
+
+    wf_id = "wf_test_resume"
+    with pytest.raises(RuntimeError):
+        workflow.run(flaky(expensive(), fail_flag), workflow_id=wf_id)
+    assert workflow.get_status(wf_id) == "FAILED"
+    assert marker.read_text() == "x"
+
+    os.remove(fail_flag)
+    result = workflow.resume(wf_id, flaky(expensive(), fail_flag))
+    assert result == 10
+    assert workflow.get_status(wf_id) == "SUCCESSFUL"
+    # expensive() was NOT re-executed: its checkpoint short-circuited
+    assert marker.read_text() == "x"
+
+
+def test_chaos_task_retry_under_worker_kills(ray_cluster):
+    """Analog of reference test_chaos.py test_chaos_task_retry: tasks keep
+    succeeding while a killer SIGKILLs random workers."""
+    from ray_tpu._private.test_utils import WorkerKiller
+
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        import time
+
+        time.sleep(0.3)
+        return i
+
+    killer = WorkerKiller(interval_s=0.7).start()
+    try:
+        refs = [work.remote(i) for i in range(24)]
+        results = ray_tpu.get(refs, timeout=240)
+    finally:
+        killed = killer.stop()
+    assert results == list(range(24))
+    assert killed, "chaos never actually killed a worker"
