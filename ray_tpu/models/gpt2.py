@@ -56,6 +56,9 @@ class GPT2Config:
     remat_policy: str = "dots"
     # "auto": pallas flash kernel on TPU, xla einsum elsewhere
     attention_impl: str = "auto"
+    # what the QK^T matmul writes: f32 (safe) or bf16 (half the [S,S] HBM
+    # traffic; softmax still accumulates f32)
+    attn_scores_dtype: Any = jnp.float32
     use_ring_attention: bool = False
 
     @property
@@ -229,7 +232,13 @@ class GPT2Model:
     def _causal_attention(self, q, k, v):
         from ray_tpu.ops.attention import causal_attention
 
-        return causal_attention(q, k, v, impl=self.config.attention_impl)
+        return causal_attention(
+            q,
+            k,
+            v,
+            impl=self.config.attention_impl,
+            scores_dtype=self.config.attn_scores_dtype,
+        )
 
     def apply(
         self,
@@ -265,7 +274,9 @@ class GPT2Model:
         var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
         x = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
         logits = x.astype(cd) @ params["wte"].astype(cd).T
-        return logits.astype(jnp.float32)
+        # stay in bf16: the loss upcasts inside fused reductions — returning
+        # f32 here would materialize an extra [B,S,V] f32 tensor in HBM
+        return logits
 
     def loss(
         self,
@@ -279,10 +290,12 @@ class GPT2Model:
         Fused form: label logit gather + logsumexp — never materializes a
         full log-softmax tensor (saves one [B,S,V] f32 HBM round-trip)."""
         cfg = self.config
-        logits = self.apply(params, tokens, mesh)
+        logits = self.apply(params, tokens, mesh).astype(jnp.float32)
         if cfg.padded_vocab != cfg.vocab_size:
-            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30, logits.dtype)
-            logits = logits.at[..., cfg.vocab_size :].set(neg)
+            # select (fuses into the logsumexp reduction) instead of a
+            # scatter, which would materialize a full [B,S,V] copy
+            pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+            logits = jnp.where(pad_mask, -1e30, logits)
         label_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
         lse = jax.nn.logsumexp(logits, axis=-1)
         return (lse - label_logit).mean()

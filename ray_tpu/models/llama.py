@@ -1,0 +1,256 @@
+"""Llama family, TPU-first: RMSNorm + RoPE + SwiGLU + grouped-query attn.
+
+The serving-side flagship (BASELINE config #5: Serve Llama-2-7B replica).
+Same functional conventions as gpt2.py — pytree params with stacked
+[n_layer, ...] leading dim, lax.scan + remat, bf16 compute, declarative
+PartitionSpecs — plus an autoregressive KV-cache decode path for Serve
+replicas (fixed-shape cache, jit-friendly, batched).
+
+The reference ships no LM; its serve replicas wrap user torch modules
+(reference: python/ray/serve/_private/replica.py:58).  Here the model is
+first-party so a deployment is jit-compiled end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        return _round_up(self.vocab_size, 128)
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama2_13b(cls, **kw) -> "LlamaConfig":
+        return cls(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40, hidden_dim=13824, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 64)
+        return cls(dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128, **kw)
+
+    def num_params(self) -> int:
+        E, L, H = self.dim, self.n_layers, self.hidden_dim
+        kv_dim = self.n_kv_heads * self.head_dim
+        per_layer = 2 * E * E + 2 * E * kv_dim + 3 * E * H + 2 * E
+        return int(self.padded_vocab * E * 2 + L * per_layer + E)
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt((x32**2).mean(-1, keepdims=True) + eps)
+    return norm * scale
+
+
+def _rope(x, positions, theta):
+    # x: [..., seq, heads, head_dim]
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class LlamaModel:
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # -------------------------------------------------------------- params
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        E, L, V, H = cfg.dim, cfg.n_layers, cfg.padded_vocab, cfg.hidden_dim
+        kv_dim = cfg.n_kv_heads * cfg.head_dim
+        pd = cfg.param_dtype
+        k = iter(jax.random.split(rng, 10))
+        std = 0.02
+
+        def norm(key, shape, s=std):
+            return (jax.random.normal(key, shape) * s).astype(pd)
+
+        return {
+            "tok_emb": norm(next(k), (V, E)),
+            "out_head": norm(next(k), (E, V)),
+            "final_norm": jnp.ones((E,), pd),
+            "layers": {
+                "attn_norm": jnp.ones((L, E), pd),
+                "ffn_norm": jnp.ones((L, E), pd),
+                "wq": norm(next(k), (L, E, E)),
+                "wk": norm(next(k), (L, E, kv_dim)),
+                "wv": norm(next(k), (L, E, kv_dim)),
+                "wo": norm(next(k), (L, E, E), std / math.sqrt(2 * L)),
+                "w_gate": norm(next(k), (L, E, H)),
+                "w_up": norm(next(k), (L, E, H)),
+                "w_down": norm(next(k), (L, H, E), std / math.sqrt(2 * L)),
+            },
+        }
+
+    def param_pspecs(self) -> Dict[str, Any]:
+        return {
+            "tok_emb": P("tp", None),
+            "out_head": P(None, "tp"),
+            "final_norm": P(None),
+            "layers": {
+                "attn_norm": P("fsdp", None),
+                "ffn_norm": P("fsdp", None),
+                "wq": P("fsdp", None, "tp"),
+                "wk": P("fsdp", None, "tp"),
+                "wv": P("fsdp", None, "tp"),
+                "wo": P("fsdp", "tp", None),
+                "w_gate": P("fsdp", None, "tp"),
+                "w_up": P("fsdp", None, "tp"),
+                "w_down": P("fsdp", "tp", None),
+            },
+        }
+
+    # ------------------------------------------------------------- forward
+
+    def _layer(self, x, lp, positions, kv_cache=None, cache_index=None):
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S, E = x.shape
+        H, KV, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+        h = _rms_norm(x, lp["attn_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        q = (h @ lp["wq"].astype(cd)).reshape(B, S, H, D)
+        k = (h @ lp["wk"].astype(cd)).reshape(B, S, KV, D)
+        v = (h @ lp["wv"].astype(cd)).reshape(B, S, KV, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        new_cache = None
+        if kv_cache is not None:
+            ck, cv = kv_cache  # [B, max_seq, KV, D]
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv)
+            kv_len = ck.shape[1]
+            kv_pos = jnp.arange(kv_len)
+            mask = kv_pos[None, :] <= positions[:, None]  # [S(q), kv_len]
+        else:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+
+        # grouped-query: repeat kv heads up to H
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cd)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, E)
+        x = x + attn @ lp["wo"].astype(cd)
+
+        h = _rms_norm(x, lp["ffn_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(cd))
+        up = h @ lp["w_up"].astype(cd)
+        x = x + (gate * up) @ lp["w_down"].astype(cd)
+        return x, new_cache
+
+    def apply(self, params, tokens, mesh=None):
+        """Train/prefill forward: tokens [B, S] → logits [B, S, V] (bf16)."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S = tokens.shape
+        x = params["tok_emb"].astype(cd)[tokens]
+        positions = jnp.arange(S)
+
+        def body(x, lp):
+            if cfg.remat:
+                y, _ = jax.checkpoint(
+                    lambda x_, lp_: self._layer(x_, lp_, positions)
+                )(x, lp)
+            else:
+                y, _ = self._layer(x, lp, positions)
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        x = _rms_norm(x, params["final_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        return x @ params["out_head"].astype(cd)
+
+    def loss(self, params, tokens, targets, mesh=None):
+        cfg = self.config
+        logits = self.apply(params, tokens, mesh).astype(jnp.float32)
+        if cfg.padded_vocab != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+            logits = jnp.where(pad_mask, -1e30, logits)
+        label_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        return (lse - label_logit).mean()
+
+    # -------------------------------------------------------------- decode
+
+    def init_cache(self, batch: int) -> Tuple:
+        """Per-layer fixed-shape KV cache: [L, B, max_seq, KV, D] pair."""
+        cfg = self.config
+        shape = (cfg.n_layers, batch, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+        return (
+            jnp.zeros(shape, cfg.compute_dtype),
+            jnp.zeros(shape, cfg.compute_dtype),
+        )
+
+    def decode_step(self, params, cache, tokens, position: jax.Array):
+        """One token per sequence: tokens [B, 1], position scalar index.
+        Returns (logits [B, V], new_cache).  jit once, call per token —
+        the Serve replica's hot loop."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B = tokens.shape[0]
+        x = params["tok_emb"].astype(cd)[tokens]  # [B, 1, E]
+        positions = jnp.array([position]) if jnp.ndim(position) == 0 else position[None]
+        positions = jnp.reshape(positions, (1,))
+
+        ck_all, cv_all = cache
+        new_k, new_v = [], []
+
+        def body(carry, inputs):
+            x = carry
+            lp, ck, cv = inputs
+            y, new_cache = self._layer(x, lp, positions, kv_cache=(ck, cv), cache_index=position)
+            return y, new_cache
+
+        x, (ck_out, cv_out) = jax.lax.scan(
+            body, x, (params["layers"], ck_all, cv_all)
+        )
+        x = _rms_norm(x, params["final_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        logits = (x @ params["out_head"].astype(cd))[:, 0, :]
+        return logits, (ck_out, cv_out)

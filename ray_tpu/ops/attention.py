@@ -20,12 +20,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_causal_attention(q, k, v, sm_scale):
+def _xla_causal_attention(q, k, v, sm_scale, scores_dtype=jnp.float32):
     S = q.shape[1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    # scores_dtype sets what the QK^T matmul writes to HBM: f32 is the safe
+    # default; bf16 halves the [S,S] tensor traffic (softmax still reduces
+    # in f32 internally via xla)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=scores_dtype
+    ) * jnp.asarray(sm_scale, scores_dtype)
     mask = jnp.tril(jnp.ones((S, S), bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    scores = jnp.where(mask[None, None], scores, jnp.asarray(-1e30, scores_dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -53,6 +58,7 @@ def causal_attention(
     *,
     sm_scale: Optional[float] = None,
     impl: str = "auto",
+    scores_dtype=jnp.float32,
 ) -> jax.Array:
     """Causal MHA.  q,k,v: [B, S, H, D] → [B, S, H, D].
 
@@ -68,7 +74,7 @@ def causal_attention(
         impl == "auto" and _on_tpu() and q.shape[1] >= 2048
     )
     if not use_flash:
-        return _xla_causal_attention(q, k, v, sm_scale)
+        return _xla_causal_attention(q, k, v, sm_scale, scores_dtype)
     flash_attention, BlockSizes = _flash()
     # kernel layout: [B, H, S, D]
     qt = q.transpose(0, 2, 1, 3)
