@@ -200,23 +200,18 @@ class GPT2Model:
             # attention only — the K/V ring rides ppermute over the sp axis
             import functools as _ft
 
+            from ray_tpu.parallel.mesh import shard_map_compat
             from ray_tpu.parallel.ring_attention import ring_attention
-
-            try:
-                from jax import shard_map
-            except ImportError:
-                from jax.experimental.shard_map import shard_map
 
             data = tuple(
                 a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
             )
             spec = jax.sharding.PartitionSpec(data or None, "sp", None, None)
-            attn = shard_map(
+            attn = shard_map_compat(
                 _ft.partial(ring_attention, axis_name="sp", causal=True),
-                mesh=mesh,
+                mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_vma=False,
             )(q, k_, v_)
         else:
             attn = self._causal_attention(q, k_, v_)

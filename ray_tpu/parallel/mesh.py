@@ -83,6 +83,20 @@ def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
     return Mesh(arr, axis_names=tuple(names))
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions (jax.shard_map vs experimental;
+    check_vma vs check_rep) — the single shared wrapper for every SPMD
+    helper in this package."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
 def data_pspec(mesh) -> "object":
     """PartitionSpec for a [batch, ...] input: batch sharded over every
     data-ish axis present (dp and fsdp both consume batch)."""

@@ -78,17 +78,19 @@ def moe_ffn(
 def make_moe_ffn(mesh, *, axis_name: str = "ep", capacity_factor: float = 1.25):
     """shard_map wrapper: tokens sharded over `ep` (data-style), experts
     sharded over `ep` (their leading dim)."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from ray_tpu.parallel.mesh import shard_map_compat
+
     fn = functools.partial(moe_ffn, axis_name=axis_name, capacity_factor=capacity_factor)
-    return shard_map(
+    return shard_map_compat(
         fn,
-        mesh=mesh,
-        in_specs=(P(axis_name, None), P(None, None), P(axis_name, None, None), P(axis_name, None, None)),
+        mesh,
+        in_specs=(
+            P(axis_name, None),
+            P(None, None),
+            P(axis_name, None, None),
+            P(axis_name, None, None),
+        ),
         out_specs=P(axis_name, None),
-        check_vma=False,
     )

@@ -62,14 +62,10 @@ def ulysses_attention(
 
 
 def make_ulysses_attention(mesh, *, causal: bool = True, axis_name: str = "sp"):
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_compat
 
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal)
-    return shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )
+    return shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
