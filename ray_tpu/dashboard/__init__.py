@@ -1,0 +1,1 @@
+from ray_tpu.dashboard.app import start_dashboard  # noqa: F401

@@ -1,0 +1,118 @@
+"""Dashboard: HTTP JSON state endpoints + a minimal HTML overview.
+
+Analog of the reference's dashboard head (reference: dashboard/head.py +
+modules/{node,actor,job}/ + state_aggregator.py — theirs is an aiohttp app
+with a React client; ours serves the same state JSON straight from the
+head tables, with a single-page plain-HTML overview).
+
+Endpoints: /api/cluster /api/nodes /api/actors /api/tasks /api/pgs
+/api/metrics /api/timeline ; / renders the overview.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+class DashboardServer:
+    """Actor hosting the aiohttp app (one per cluster, like the reference's
+    dashboard head process)."""
+
+    def __init__(self, port: int):
+        self.port = port
+
+    async def start(self) -> str:
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.experimental.state import (
+            list_actors,
+            list_nodes,
+            list_placement_groups,
+            list_tasks,
+        )
+        from ray_tpu.util import metrics as metrics_mod
+
+        def _json(data):
+            return web.json_response(data)
+
+        async def api_cluster(request):
+            return _json(
+                {
+                    "resources_total": ray_tpu.cluster_resources(),
+                    "resources_available": ray_tpu.available_resources(),
+                }
+            )
+
+        async def api_nodes(request):
+            return _json(list_nodes())
+
+        async def api_actors(request):
+            return _json(list_actors())
+
+        async def api_tasks(request):
+            return _json(list_tasks())
+
+        async def api_pgs(request):
+            return _json(list_placement_groups())
+
+        async def api_metrics(request):
+            return web.Response(text=metrics_mod.prometheus_text())
+
+        async def api_timeline(request):
+            return _json(ray_tpu.timeline())
+
+        async def index(request):
+            total = ray_tpu.cluster_resources()
+            avail = ray_tpu.available_resources()
+            nodes = list_nodes()
+            actors = list_actors()
+            rows = "".join(
+                f"<tr><td>{n['node_id'][:12]}</td><td>{'alive' if n['alive'] else 'dead'}</td>"
+                f"<td>{n['num_workers']}</td><td>{json.dumps(n['resources'])}</td></tr>"
+                for n in nodes
+            )
+            res_rows = "".join(
+                f"<tr><td>{k}</td><td>{avail.get(k, 0):.1f} / {v:.1f}</td></tr>"
+                for k, v in sorted(total.items())
+            )
+            alive_actors = sum(1 for a in actors if a["state"] == "ALIVE")
+            html = f"""<html><head><title>ray_tpu dashboard</title></head><body>
+            <h2>ray_tpu cluster</h2>
+            <h3>Resources (available / total)</h3>
+            <table border=1>{res_rows}</table>
+            <h3>Nodes ({len(nodes)})</h3>
+            <table border=1><tr><th>id</th><th>state</th><th>workers</th><th>resources</th></tr>{rows}</table>
+            <h3>Actors: {alive_actors} alive / {len(actors)} total</h3>
+            <p>JSON: <a href=/api/cluster>cluster</a> <a href=/api/nodes>nodes</a>
+            <a href=/api/actors>actors</a> <a href=/api/tasks>tasks</a>
+            <a href=/api/pgs>pgs</a> <a href=/api/metrics>metrics</a>
+            <a href=/api/timeline>timeline</a></p>
+            </body></html>"""
+            return web.Response(text=html, content_type="text/html")
+
+        app = web.Application()
+        app.router.add_get("/", index)
+        app.router.add_get("/api/cluster", api_cluster)
+        app.router.add_get("/api/nodes", api_nodes)
+        app.router.add_get("/api/actors", api_actors)
+        app.router.add_get("/api/tasks", api_tasks)
+        app.router.add_get("/api/pgs", api_pgs)
+        app.router.add_get("/api/metrics", api_metrics)
+        app.router.add_get("/api/timeline", api_timeline)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        await site.start()
+        return f"http://127.0.0.1:{self.port}"
+
+
+def start_dashboard(port: int = 8265) -> str:
+    """Launch the dashboard actor; returns its URL
+    (reference default port 8265)."""
+    import ray_tpu
+
+    cls = ray_tpu.remote(DashboardServer)
+    actor = cls.options(num_cpus=0, name="_dashboard", lifetime="detached").remote(port)
+    return ray_tpu.get(actor.start.remote(), timeout=120)

@@ -1,0 +1,132 @@
+"""multiprocessing.Pool API over the cluster.
+
+Analog of the reference's ray.util.multiprocessing (reference:
+python/ray/util/multiprocessing/pool.py — drop-in Pool whose workers are
+actors, so `Pool(8).map(f, xs)` scales past one machine).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs: List):
+        self._refs = refs
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        results = ray_tpu.get(self._refs, timeout=timeout or 300)
+        return results if len(results) != 1 else results[0]
+
+    def wait(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, initializer=None, initargs=()):
+        import ray_tpu
+
+        self._n = processes or 4
+
+        class _PoolWorker:
+            def __init__(self):
+                if initializer:
+                    initializer(*initargs)
+
+            def run(self, fn, chunk):
+                return [fn(x) for x in chunk]
+
+            def run_star(self, fn, chunk):
+                return [fn(*x) for x in chunk]
+
+        cls = ray_tpu.remote(_PoolWorker)
+        self._workers = [cls.remote() for _ in range(self._n)]
+        self._rr = itertools.count()
+
+    def _chunks(self, iterable, chunksize):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i : i + chunksize] for i in range(0, len(items), chunksize)]
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize: Optional[int] = None) -> List:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        import ray_tpu
+
+        refs = [
+            self._workers[next(self._rr) % self._n].run.remote(fn, chunk)
+            for chunk in self._chunks(iterable, chunksize)
+        ]
+        return _FlattenResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable, chunksize: Optional[int] = None) -> List:
+        import ray_tpu
+
+        refs = [
+            self._workers[next(self._rr) % self._n].run_star.remote(fn, chunk)
+            for chunk in self._chunks(iterable, chunksize)
+        ]
+        return _FlattenResult(refs).get()
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        import functools
+
+        bound = functools.partial(fn, *args, **(kwds or {}))
+        worker = self._workers[next(self._rr) % self._n]
+        return _SingleResult([worker.run.remote(lambda _: bound(), [None])])
+
+    def imap(self, fn, iterable, chunksize=None):
+        for chunk_result in self.map(fn, iterable, chunksize):
+            yield chunk_result
+
+    def close(self):
+        pass
+
+    def terminate(self):
+        import ray_tpu
+
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _SingleResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._refs[0], timeout=timeout or 300)[0]
+
+
+class _FlattenResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._refs, timeout=timeout or 300)
+        return [x for chunk in chunks for x in chunk]

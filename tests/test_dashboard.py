@@ -1,0 +1,65 @@
+"""Dashboard + multiprocessing Pool tests."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_dashboard_endpoints(ray_cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.options(name="dash_marker").remote()
+    ray_tpu.get(m.ping.remote(), timeout=60)
+
+    url = start_dashboard(port=18265)
+
+    def fetch(path):
+        deadline = time.time() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(url + path, timeout=10) as r:
+                    return r.read().decode()
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    cluster = json.loads(fetch("/api/cluster"))
+    assert cluster["resources_total"]["CPU"] == 4.0
+    actors = json.loads(fetch("/api/actors"))
+    assert any(a["name"] == "dash_marker" for a in actors)
+    nodes = json.loads(fetch("/api/nodes"))
+    assert len(nodes) == 1
+    html = fetch("/")
+    assert "ray_tpu cluster" in html
+    metrics_text = fetch("/api/metrics")
+    assert isinstance(metrics_text, str)
+
+
+def test_multiprocessing_pool(ray_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    with Pool(2) as pool:
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(square, (6,))
+        assert r.get(timeout=60) == 36
