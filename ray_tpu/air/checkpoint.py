@@ -59,10 +59,15 @@ class Checkpoint:
     def to_dict(self) -> dict:
         if self._data is not None:
             return self._data
+        # Walk the whole tree (orbax-style layouts are nested); keys are
+        # "/"-joined paths relative to the checkpoint root.
         out = {}
-        for name in os.listdir(self._dir):
-            with open(os.path.join(self._dir, name), "rb") as f:
-                out[name] = f.read()
+        for dirpath, _, filenames in os.walk(self._dir):
+            rel = os.path.relpath(dirpath, self._dir)
+            for name in filenames:
+                key = name if rel == "." else "/".join([*rel.split(os.sep), name])
+                with open(os.path.join(dirpath, name), "rb") as f:
+                    out[key] = f.read()
         return out
 
     def to_bytes(self) -> bytes:

@@ -32,6 +32,19 @@ def _join_collective(worker, world_size, rank, backend, group_name):
     return True
 
 
+def _resolve_worker_host(worker):
+    """Runs ON the rank-0 worker: the address the coordination service will
+    bind, so it must be that worker's host — not the driver's (the driver
+    may live on a different machine than any training worker)."""
+    import os
+
+    from ray_tpu.util.collective.dcn_backend import _self_ip
+
+    # route-based self-discovery, not gethostbyname(gethostname()) — the
+    # latter resolves to 127.0.1.1 on stock Debian and is undialable
+    return os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
+
+
 def _init_jax_distributed(worker, coordinator, num_processes, process_id):
     import jax
 
@@ -67,14 +80,15 @@ class _JaxBackend(Backend):
     def on_start(self, worker_group, config: JaxConfig):
         n = len(worker_group)
         if config.use_jax_distributed:
-            # rank 0's host:port becomes the coordination service address
-            # (reference analog: MASTER_ADDR/PORT broadcast, config.py:123-160)
-            import socket
+            # rank-0 WORKER's host:port becomes the coordination service
+            # address — process 0 binds it, so it must be resolved on that
+            # worker (reference analog: MASTER_ADDR discovery broadcast,
+            # train/torch/config.py:123-160)
+            import ray_tpu
 
-            host = socket.gethostbyname(socket.gethostname())
+            host = worker_group.execute_single(0, _resolve_worker_host, timeout=60)
             port = 8476
             coordinator = f"{host}:{port}"
-            import ray_tpu
 
             refs = [
                 w.execute.remote(_init_jax_distributed, coordinator, n, rank)

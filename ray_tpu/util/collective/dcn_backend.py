@@ -18,7 +18,8 @@ optimal for large tensors over slow links.
 from __future__ import annotations
 
 import os
-import pickle
+import secrets
+import select
 import socket
 import struct
 import threading
@@ -30,6 +31,9 @@ import numpy as np
 from ray_tpu.util.collective.types import ReduceOp
 
 _LEN = struct.Struct("<Q")
+_U16 = struct.Struct("<H")
+_U8 = struct.Struct("<B")
+_IO_CHUNK = 1 << 20  # bounded per-syscall transfer so send/recv interleave
 
 
 def _self_ip() -> str:
@@ -47,6 +51,27 @@ def _self_ip() -> str:
 
 def _send_msg(sock: socket.socket, payload: bytes):
     sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_bounded_msg(sock: socket.socket, max_len: int) -> bytes:
+    """Like _recv_msg but refuses oversized frames BEFORE allocating —
+    for reads from unverified peers."""
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    if n > max_len:
+        raise ConnectionError(f"frame too large from unverified peer ({n} bytes)")
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
 
 
 def _recv_msg(sock: socket.socket) -> bytes:
@@ -68,17 +93,125 @@ def _recv_msg(sock: socket.socket) -> bytes:
     return bytes(buf)
 
 
+def _encode_array(arr: np.ndarray):
+    """One length-prefixed frame per array.  Fixed struct header (dtype str +
+    shape) — no pickle on the wire, so a peer can never inject code via the
+    header.  Returns (prefix_bytes, data_view); data is not copied."""
+    arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode("ascii")
+    shape = arr.shape
+    header = (
+        _U16.pack(len(dt))
+        + dt
+        + _U8.pack(len(shape))
+        + struct.pack(f"<{len(shape)}q", *shape)
+    )
+    data = memoryview(arr).cast("B")
+    prefix = _LEN.pack(len(header) + len(data)) + header
+    return prefix, data
+
+
+def _decode_array(payload) -> np.ndarray:
+    view = memoryview(payload)
+    (dt_len,) = _U16.unpack_from(view, 0)
+    off = _U16.size
+    dtype = np.dtype(view[off : off + dt_len].tobytes().decode("ascii"))
+    off += dt_len
+    (ndim,) = _U8.unpack_from(view, off)
+    off += _U8.size
+    shape = struct.unpack_from(f"<{ndim}q", view, off)
+    off += 8 * ndim
+    return np.frombuffer(view[off:], dtype=dtype).reshape(shape)
+
+
 def _send_array(sock: socket.socket, arr: np.ndarray):
-    header = pickle.dumps((arr.dtype.str, arr.shape))
-    _send_msg(sock, header)
-    data = np.ascontiguousarray(arr)
-    _send_msg(sock, data.tobytes())
+    prefix, data = _encode_array(arr)
+    sock.sendall(prefix)
+    sock.sendall(data)
+
+
+def _recv_payload(sock: socket.socket) -> bytearray:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("collective peer closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(_IO_CHUNK, n - got))
+        if r == 0:
+            raise ConnectionError("collective peer closed")
+        got += r
+    return buf
 
 
 def _recv_array(sock: socket.socket) -> np.ndarray:
-    dtype_str, shape = pickle.loads(_recv_msg(sock))
-    data = _recv_msg(sock)
-    return np.frombuffer(bytearray(data), dtype=np.dtype(dtype_str)).reshape(shape)
+    return _decode_array(_recv_payload(sock))
+
+
+def _exchange_array(
+    send_sock: socket.socket, recv_sock: socket.socket, arr: np.ndarray, timeout: float = 600.0
+) -> np.ndarray:
+    """Full-duplex: send `arr` on send_sock while receiving one array from
+    recv_sock, interleaved via select with bounded per-syscall transfers.
+
+    This is what makes the ring safe for arbitrarily large tensors: a naive
+    sendall-then-recv has every rank blocking in send once a chunk exceeds
+    the kernel TCP buffers (all ranks send simultaneously, nobody drains).
+    NCCL/pygloo rings pipeline segments for the same reason."""
+    pending = [m for m in _encode_array(arr) if len(m)]
+    pending = [memoryview(m) for m in pending]
+    recv_hdr = bytearray()
+    recv_buf: Optional[bytearray] = None
+    recv_view: Optional[memoryview] = None
+    recv_got = 0
+    recv_need = -1
+    send_sock.setblocking(False)
+    try:
+        deadline = time.time() + timeout
+        while pending or recv_need != 0:
+            if time.time() > deadline:
+                raise TimeoutError("collective exchange timed out")
+            rlist = [recv_sock] if recv_need != 0 else []
+            wlist = [send_sock] if pending else []
+            readable, writable, _ = select.select(rlist, wlist, [], 10.0)
+            if writable:
+                head = pending[0]
+                try:
+                    sent = send_sock.send(head[:_IO_CHUNK])
+                except (BlockingIOError, InterruptedError):
+                    sent = 0
+                if sent:
+                    if sent == len(head):
+                        pending.pop(0)
+                    else:
+                        pending[0] = head[sent:]
+            if readable:
+                if recv_need < 0:
+                    chunk = recv_sock.recv(_LEN.size - len(recv_hdr))
+                    if not chunk:
+                        raise ConnectionError("collective peer closed")
+                    recv_hdr += chunk
+                    if len(recv_hdr) == _LEN.size:
+                        (recv_need,) = _LEN.unpack(recv_hdr)
+                        recv_buf = bytearray(recv_need)
+                        recv_view = memoryview(recv_buf)
+                elif recv_need > 0:
+                    r = recv_sock.recv_into(
+                        recv_view[recv_got:], min(_IO_CHUNK, recv_need - recv_got)
+                    )
+                    if r == 0:
+                        raise ConnectionError("collective peer closed")
+                    recv_got += r
+                    if recv_got == recv_need:
+                        recv_need = 0
+    finally:
+        send_sock.setblocking(True)
+    return _decode_array(recv_buf)
 
 
 def _reduce_arrays(a: np.ndarray, b: np.ndarray, op: ReduceOp) -> np.ndarray:
@@ -113,30 +246,70 @@ class DcnGroup:
     def _kv_key(self, rank: int) -> str:
         return f"collective:{self.group_name}:addr:{rank}"
 
+    def _token_key(self, rank: int) -> str:
+        return f"collective:{self.group_name}:token:{rank}"
+
     def _build_ring(self):
-        """Every rank listens; rank i dials rank (i+1) % n.  Addresses are
-        published through the head KV (rendezvous)."""
+        """Every rank listens; rank i dials rank (i+1) % n.  Addresses and
+        per-rank join tokens are published through the head KV (rendezvous);
+        an inbound connection is admitted only after a hello frame carrying
+        (group, rank, token) matches the KV-published token — a stray or
+        malicious connection cannot occupy a ring slot, and the hello is a
+        fixed text frame, never unpickled."""
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("0.0.0.0", 0))
-        listener.listen(2)
+        listener.listen(4)
         self._listener = listener
         port = listener.getsockname()[1]
         # advertise an address other hosts can dial, not the bind wildcard:
         # RAY_TPU_NODE_IP wins (TPU-VM metadata sets it), else best-effort
         # route-based self-discovery, else loopback (single-host)
         host = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
+        token = secrets.token_hex(16)
+        self._kv.kv_put(self._token_key(self.rank), token.encode())
         self._kv.kv_put(self._kv_key(self.rank), f"{host}:{port}".encode())
 
         next_rank = (self.rank + 1) % self.world_size
+        prev_rank = (self.rank - 1) % self.world_size
+        # Every rank publishes before waiting on anything, so these two gets
+        # cannot deadlock; fetching the expected token here (main thread)
+        # keeps KV access out of the accept thread.
+        expected = self._kv.kv_get(self._token_key(prev_rank), wait=True, timeout=120)
+        if expected is None:
+            raise TimeoutError(f"rendezvous timed out for rank {prev_rank} token")
+        expected_hello = f"{self.group_name}\n{prev_rank}\n{expected.decode()}".encode()
 
         # accept from prev in a thread while dialing next (avoids deadlock)
         accepted: List[socket.socket] = []
 
         def _accept():
-            sock, _ = listener.accept()
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            accepted.append(sock)
+            deadline = time.time() + 120
+            listener.settimeout(10)
+            while time.time() < deadline:
+                try:
+                    sock, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    # Bounded hello read: length is attacker-controlled until
+                    # verified, so never allocate it blindly, and give slow
+                    # strays only a short window so they can't exhaust the
+                    # rendezvous deadline.
+                    sock.settimeout(5)
+                    hello = _recv_bounded_msg(sock, max_len=4096)
+                    sock.settimeout(None)
+                except Exception:
+                    sock.close()
+                    continue
+                if hello != expected_hello:
+                    sock.close()
+                    continue
+                accepted.append(sock)
+                return
 
         t = threading.Thread(target=_accept, daemon=True)
         t.start()
@@ -155,10 +328,11 @@ class DcnGroup:
                     raise
                 time.sleep(0.05)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(s, f"{self.group_name}\n{self.rank}\n{token}".encode())
         self._next_sock = s
         t.join(timeout=120)
         if not accepted:
-            raise TimeoutError("ring accept timed out")
+            raise TimeoutError("ring accept timed out (no verified peer)")
         self._prev_sock = accepted[0]
 
     # ----------------------------------------------------------- primitives
@@ -181,19 +355,21 @@ class DcnGroup:
             flat = np.ascontiguousarray(arr).reshape(-1)
             chunks = np.array_split(flat, n)
             chunks = [c.copy() for c in chunks]
-            # reduce-scatter
+            # reduce-scatter (full-duplex per step: all ranks send+recv
+            # simultaneously, so the exchange must interleave — see
+            # _exchange_array)
             for step in range(n - 1):
                 send_idx = (self.rank - step) % n
                 recv_idx = (self.rank - step - 1) % n
-                self.send_next(chunks[send_idx])
-                incoming = self.recv_prev()
+                incoming = _exchange_array(self._next_sock, self._prev_sock, chunks[send_idx])
                 chunks[recv_idx] = _reduce_arrays(chunks[recv_idx], incoming, op)
             # allgather
             for step in range(n - 1):
                 send_idx = (self.rank + 1 - step) % n
                 recv_idx = (self.rank - step) % n
-                self.send_next(chunks[send_idx])
-                chunks[recv_idx] = self.recv_prev()
+                chunks[recv_idx] = _exchange_array(
+                    self._next_sock, self._prev_sock, chunks[send_idx]
+                )
             out = np.concatenate(chunks)
             return out.reshape(arr.shape).astype(arr.dtype, copy=False)
 
@@ -224,8 +400,7 @@ class DcnGroup:
             current = pieces[self.rank]
             cur_rank = self.rank
             for _ in range(n - 1):
-                self.send_next(current)
-                current = self.recv_prev()
+                current = _exchange_array(self._next_sock, self._prev_sock, current)
                 cur_rank = (cur_rank - 1) % n
                 pieces[cur_rank] = current
             return [pieces[i] for i in range(n)]

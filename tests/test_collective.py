@@ -62,31 +62,35 @@ def test_dcn_group_allreduce_between_actors(ray_start_regular):
     assert ray_tpu.get(refs, timeout=120) == [10.0, 10.0, 10.0]
 
 
+import threading as _threading
+
+
+class FakeKv:
+    def __init__(self):
+        self.d = {}
+        self.cv = _threading.Condition()
+
+    def kv_put(self, key, value):
+        with self.cv:
+            self.d[key] = value
+            self.cv.notify_all()
+
+    def kv_get(self, key, wait=False, timeout=None):
+        import time
+
+        deadline = time.time() + (timeout or 30)
+        with self.cv:
+            while key not in self.d:
+                if not self.cv.wait(timeout=max(0.01, deadline - time.time())):
+                    return None
+            return self.d[key]
+
+
 def test_dcn_ring_allreduce_correctness_local():
     """Pure-algorithm check without the cluster: 4 in-process ranks."""
     import threading
 
     from ray_tpu.util.collective.dcn_backend import DcnGroup
-
-    class FakeKv:
-        def __init__(self):
-            self.d = {}
-            self.cv = threading.Condition()
-
-        def kv_put(self, key, value):
-            with self.cv:
-                self.d[key] = value
-                self.cv.notify_all()
-
-        def kv_get(self, key, wait=False, timeout=None):
-            import time
-
-            deadline = time.time() + (timeout or 30)
-            with self.cv:
-                while key not in self.d:
-                    if not self.cv.wait(timeout=max(0.01, deadline - time.time())):
-                        return None
-                return self.d[key]
 
     kv = FakeKv()
     n = 4
@@ -108,6 +112,79 @@ def test_dcn_ring_allreduce_correctness_local():
     for r in range(n):
         # ring reduction order differs from sum(); allow fp slack
         np.testing.assert_allclose(results[r], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_dcn_ring_allreduce_large_tensor():
+    """Regression for the sendall deadlock: with 2 ranks each chunk is half
+    of a 64 MB tensor — far beyond kernel TCP buffers, so a naive
+    send-then-recv ring hangs here.  The interleaved exchange must not."""
+    import threading
+
+    from ray_tpu.util.collective.dcn_backend import DcnGroup
+
+    kv = FakeKv()
+    n = 2
+    results = [None] * n
+    errors = []
+    elems = 16 * 1024 * 1024  # 64 MB float32
+    inputs = [np.full(elems, float(r + 1), dtype=np.float32) for r in range(n)]
+
+    def run(rank):
+        try:
+            g = DcnGroup("big", n, rank, kv)
+            results[rank] = g.allreduce(inputs[rank])
+            g.destroy()
+        except Exception as e:  # surface in main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for r in range(n):
+        assert results[r] is not None, "allreduce deadlocked"
+        assert results[r].shape == (elems,)
+        np.testing.assert_allclose(results[r][:: elems // 97], 3.0)
+
+
+def test_dcn_ring_rejects_unverified_connection():
+    """A stray connection (wrong/missing join token) must not occupy a ring
+    slot: the group still forms between the two real ranks."""
+    import socket
+    import threading
+    import time
+
+    from ray_tpu.util.collective.dcn_backend import DcnGroup, _send_msg
+
+    kv = FakeKv()
+    results = [None] * 2
+
+    def run(rank, delay):
+        time.sleep(delay)
+        g = DcnGroup("hs", 2, rank, kv)
+        results[rank] = g.allreduce(np.full(16, float(rank + 1), dtype=np.float32))
+        g.destroy()
+
+    t0 = threading.Thread(target=run, args=(0, 0.0), daemon=True)
+    t0.start()
+
+    # As soon as rank 0 advertises, connect with a bogus hello (before the
+    # real dialer, which is delayed).
+    addr = kv.kv_get("collective:hs:addr:0", wait=True, timeout=30)
+    host, port = addr.decode().rsplit(":", 1)
+    stray = socket.create_connection((host, int(port)), timeout=10)
+    _send_msg(stray, b"hs\n1\ndeadbeef")  # wrong token
+
+    t1 = threading.Thread(target=run, args=(1, 0.3), daemon=True)
+    t1.start()
+    t0.join(timeout=60)
+    t1.join(timeout=60)
+    stray.close()
+    for r in range(2):
+        assert results[r] is not None, "ring never formed"
+        np.testing.assert_allclose(results[r], 3.0)
 
 
 def test_ici_group_allreduce_virtual_devices():

@@ -86,3 +86,21 @@ def test_job_failure_reported(ray_cluster):
     client = JobSubmissionClient()
     job_id = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
     assert client.wait_until_finish(job_id, timeout=60) == JobStatus.FAILED
+
+
+def test_checkpoint_nested_directory_roundtrip(tmp_path):
+    """Orbax-style checkpoints are nested trees; to_dict must walk them
+    (reference analog: air/checkpoint.py dir<->dict interconversion)."""
+    from ray_tpu.air import Checkpoint
+
+    d = tmp_path / "ckpt"
+    (d / "state" / "layer0").mkdir(parents=True)
+    (d / "top.bin").write_bytes(b"root")
+    (d / "state" / "meta.json").write_bytes(b"{}")
+    (d / "state" / "layer0" / "w.npy").write_bytes(b"\x01\x02")
+
+    ckpt = Checkpoint.from_directory(str(d))
+    out = ckpt.to_dict()
+    assert out["top.bin"] == b"root"
+    assert out["state/meta.json"] == b"{}"
+    assert out["state/layer0/w.npy"] == b"\x01\x02"
