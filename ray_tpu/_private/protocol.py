@@ -66,6 +66,8 @@ class MsgType(enum.IntEnum):
     ADD_REF = 45
     REMOVE_REF = 46
     PIN_OBJECT = 47
+    OBJECT_PULL = 48  # head → raylet: pull oid from a peer's transfer agent
+    OBJECT_DELETE = 49  # head → raylet: drop local copy
 
     # KV + pubsub (analog: gcs_kv_manager.h, pubsub.proto)
     KV_PUT = 50

@@ -257,7 +257,7 @@ class CoreWorker:
     def put_object(self, oid: bytes, sobj: SerializedObject):
         if not self.store.put_serialized(oid, sobj):
             pass  # already present (idempotent put)
-        self.request(MsgType.PUT_OBJECT, {"object_id": oid})
+        self.request(MsgType.PUT_OBJECT, {"object_id": oid, "node_id": self.node_id})
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = time.monotonic() + timeout if timeout is not None else None
@@ -273,15 +273,28 @@ class CoreWorker:
         if pending:
             self._notify_blocked(True)
             try:
-                for i, oid in pending:
-                    rem = None
-                    if deadline is not None:
-                        rem = max(0.0, deadline - time.monotonic())
-                    reply = self.request(
-                        MsgType.WAIT_OBJECT,
-                        {"object_id": oid, "timeout": rem},
-                        timeout=(rem + 5) if rem is not None else 3600,
+                rem = None
+                if deadline is not None:
+                    rem = max(0.0, deadline - time.monotonic())
+
+                # one concurrent WAIT_OBJECT per missing ref: each reply may
+                # embed a cross-node transfer (the head pulls the object onto
+                # OUR node before replying "sealed"), so issuing them together
+                # lets the agents overlap the copies
+                async def _wait_all():
+                    return await asyncio.gather(
+                        *[
+                            self.conn.request(
+                                MsgType.WAIT_OBJECT,
+                                {"object_id": oid, "timeout": rem, "node_id": self.node_id},
+                                (rem + 5) if rem is not None else 3600,
+                            )
+                            for _, oid in pending
+                        ]
                     )
+
+                replies = self.io.call(_wait_all())
+                for (i, oid), reply in zip(pending, replies):
                     state = reply.get("state")
                     if state == "timeout":
                         raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")

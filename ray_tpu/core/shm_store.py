@@ -212,7 +212,47 @@ class ShmObjectStore:
             pos = _pad(pos + blen)
         return SerializedObject(bytes(metadata), inband, buffers)
 
-    # -- raw ops --------------------------------------------------------------
+    # -- raw ops (object-transfer layer) --------------------------------------
+
+    def raw_view(self, object_id: bytes) -> Optional[memoryview]:
+        """Pinned zero-copy view of a sealed object's full store value (the
+        serialized wire image).  The pin is released when the view's owner
+        (_PinnedRegion) is garbage collected.  Used by the transfer agent to
+        stream an object to another node byte-for-byte."""
+        self._check(object_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, object_id, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        region = _PinnedRegion(self, object_id, self._mv[off.value : off.value + size.value])
+        return memoryview(region)
+
+    def raw_create(self, object_id: bytes, size: int) -> Optional[memoryview]:
+        """Allocate an unsealed object of `size` bytes and return a writable
+        view; None if the id already exists.  Pair with raw_seal/raw_abort.
+        This is the receive half of a chunked pull (analog: reference
+        ObjectBufferPool create-chunk path, object_manager/object_buffer_pool.h)."""
+        self._check(object_id)
+        off = ctypes.c_uint64()
+        rc = self._lib.store_alloc(self._handle, object_id, size, ctypes.byref(off))
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise MemoryError(
+                f"shm store cannot fit object of {size} bytes "
+                f"(used {self.used()}/{self.capacity()})"
+            )
+        return self._mv[off.value : off.value + size]
+
+    def raw_seal(self, object_id: bytes):
+        if self._lib.store_seal(self._handle, object_id) != 0:
+            self._lib.store_abort(self._handle, object_id)
+            raise RuntimeError("seal failed")
+        self._lib.store_release(self._handle, object_id)  # drop creator pin
+
+    def raw_abort(self, object_id: bytes):
+        self._lib.store_abort(self._handle, object_id)
 
     def contains(self, object_id: bytes) -> bool:
         if not self._handle:

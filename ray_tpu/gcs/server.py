@@ -100,6 +100,7 @@ class NodeInfo:
         "starting_workers",
         "labels",
         "address",
+        "transfer_addr",
         "_sched",
     )
 
@@ -120,6 +121,7 @@ class NodeInfo:
         self.starting_workers = 0
         self.labels: Dict[str, str] = {}
         self.address = ""
+        self.transfer_addr = ""
         self._sched = sched
         if sched is not None:
             sched.upsert_node(node_id, self.resources_total)
@@ -254,6 +256,11 @@ class HeadServer:
         self.objects: Dict[bytes, List] = {}
         self.object_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self.object_refcounts: Dict[bytes, int] = {}
+        # oid -> set of node_ids holding a sealed copy (analog: reference
+        # OwnershipBasedObjectDirectory location sets)
+        self.object_locations: Dict[bytes, set] = {}
+        # (oid, dest_node) -> future, coalescing concurrent pull requests
+        self._pull_inflight: Dict[Tuple[bytes, bytes], asyncio.Future] = {}
 
         self.kv: Dict[str, bytes] = {}
         # pubsub: channel -> {conn_id: Connection}
@@ -292,8 +299,20 @@ class HeadServer:
         self.nodes[self.head_node_id] = node
         # create the shm store segment for the head node
         from ray_tpu.core.shm_store import ShmObjectStore
+        from ray_tpu.raylet.object_agent import ObjectTransferAgent
 
         self._store = ShmObjectStore(self.store_path, capacity=self.store_capacity, create=True)
+        # the head node participates in the transfer mesh like any raylet;
+        # advertise a dialable address (bind wildcard → route-based self-IP)
+        self.object_agent = ObjectTransferAgent(self._store)
+        transfer_port = await self.object_agent.start()
+        if self.host not in ("0.0.0.0", ""):
+            advertise = self.host
+        else:
+            from ray_tpu.util.collective.dcn_backend import _self_ip
+
+            advertise = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
+        node.transfer_addr = f"{advertise}:{transfer_port}"
 
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -314,6 +333,10 @@ class HeadServer:
             conn.close()
         if self._server:
             self._server.close()
+        try:
+            self.object_agent.stop()
+        except Exception:
+            pass
         try:
             self._store.close()
         except Exception:
@@ -380,6 +403,7 @@ class HeadServer:
         nid = p["node_id"]
         node = NodeInfo(nid, conn, p["resources"], p["store_path"], sched=self.sched)
         node.address = p.get("address", "")
+        node.transfer_addr = p.get("transfer_addr", "")
         self.nodes[nid] = node
         self._conn_kind[cid] = "raylet"
         self._conn_node[cid] = nid
@@ -431,6 +455,11 @@ class HeadServer:
                     pg.state = "RESCHEDULING"
         del self.nodes[nid]
         self.sched.remove_node(nid)
+        # its object copies are gone with its store segment
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(nid)
+            if not locs:
+                del self.object_locations[oid]
         await self._publish("node", {"event": "dead", "node_id": nid})
         self._kick_scheduler()
 
@@ -444,6 +473,14 @@ class HeadServer:
         if node:
             node.workers.pop(wid, None)
         logger.info("worker %s dead: %s", wid.hex()[:8], reason)
+        # if the process is actually still alive (e.g. declared dead because
+        # its node was removed), cut its head connection so it exits instead
+        # of lingering as a zombie reporter
+        try:
+            if w.conn is not None:
+                w.conn.close()
+        except Exception:
+            pass
         # fail or retry its running tasks
         for tid in list(w.running_tasks):
             entry = self.tasks.pop(tid, None)
@@ -566,15 +603,92 @@ class HeadServer:
                 if not fut.done():
                     fut.set_result(e)
 
+    def _add_location(self, oid: bytes, node_id: Optional[bytes]):
+        # only live nodes can serve copies; a zombie worker on a removed
+        # node must not pollute the directory
+        if node_id and bytes(node_id) in self.nodes:
+            self.object_locations.setdefault(oid, set()).add(bytes(node_id))
+
     async def h_put_object(self, cid, conn, p):
+        nid = p.get("node_id")
+        if nid is None:
+            nid = self._conn_node.get(cid) or self.head_node_id
+        self._add_location(p["object_id"], nid)
         await self._seal_object(p["object_id"])
         return {"ok": True}
+
+    async def _ensure_object_local(
+        self, oid: bytes, dest_nid: bytes, timeout: Optional[float] = None
+    ) -> Optional[str]:
+        """Make a sealed object present on dest node; returns None on
+        success, "__timeout__" if `timeout` lapsed (transfer continues in
+        the background), or an error string.  Pulls coalesce per (oid,
+        dest) and run as their own task so a timed-out waiter never cancels
+        the transfer for other waiters."""
+        locs = self.object_locations.get(oid)
+        if not locs:
+            return f"ObjectLostError: {oid.hex()[:16]} sealed but no live copy"
+        if dest_nid in locs:
+            return None
+        key = (oid, dest_nid)
+        task = self._pull_inflight.get(key)
+        if task is None:
+
+            async def _run():
+                try:
+                    return await self._pull_to_node(oid, dest_nid)
+                except Exception as e:  # noqa: BLE001
+                    return f"transfer failed: {e}"
+                finally:
+                    self._pull_inflight.pop(key, None)
+
+            task = asyncio.get_running_loop().create_task(_run())
+            self._pull_inflight[key] = task
+        try:
+            return await asyncio.wait_for(asyncio.shield(task), timeout)
+        except asyncio.TimeoutError:
+            return "__timeout__"
+
+    async def _pull_to_node(self, oid: bytes, dest_nid: bytes) -> Optional[str]:
+        last_err = "no live copy"
+        for src_nid in list(self.object_locations.get(oid, ())):
+            src = self.nodes.get(src_nid)
+            if src is None or not src.alive or not src.transfer_addr:
+                continue
+            if dest_nid == self.head_node_id:
+                try:
+                    ok = await asyncio.wait_for(
+                        self.object_agent.pull(oid, src.transfer_addr), timeout=300
+                    )
+                except Exception as e:  # noqa: BLE001
+                    ok, last_err = False, f"{type(e).__name__}: {e}"
+                if ok:
+                    self._add_location(oid, dest_nid)
+                    return None
+            else:
+                dest = self.nodes.get(dest_nid)
+                if dest is None or dest.conn is None:
+                    return f"destination node {dest_nid.hex()[:8]} gone"
+                try:
+                    reply = await dest.conn.request(
+                        MsgType.OBJECT_PULL,
+                        {"object_id": oid, "src_addr": src.transfer_addr},
+                        timeout=310,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                if reply.get("ok"):
+                    self._add_location(oid, dest_nid)
+                    return None
+                last_err = reply.get("error", "pull refused")
+        return f"ObjectLostError: transfer of {oid.hex()[:16]} failed: {last_err}"
 
     async def h_wait_object(self, cid, conn, p):
         if "object_ids" in p:
             return await self._wait_batch(p)
         oid = p["object_id"]
         timeout = p.get("timeout")
+        deadline = time.time() + timeout if timeout is not None else None
         e = self._object_entry(oid)
         if e[0] == PENDING:
             fut = asyncio.get_running_loop().create_future()
@@ -586,6 +700,16 @@ class HeadServer:
         e = self.objects[oid]
         if e[0] == ERRORED:
             return {"state": "error", "error": e[1]}
+        # cross-node data plane: fetch the object onto the waiter's node
+        # within what's left of the caller's deadline
+        dest_nid = p.get("node_id")
+        if dest_nid is not None:
+            rem = None if deadline is None else max(0.001, deadline - time.time())
+            err = await self._ensure_object_local(oid, bytes(dest_nid), timeout=rem)
+            if err == "__timeout__":
+                return {"state": "timeout"}
+            if err is not None:
+                return {"state": "error", "error": err}
         return {"state": "sealed"}
 
     async def _wait_batch(self, p):
@@ -614,10 +738,26 @@ class HeadServer:
             for f in pending:
                 f.cancel()
 
+    def _delete_everywhere(self, oid: bytes):
+        """Drop all copies: head store directly, remote nodes by directive."""
+        locs = self.object_locations.pop(oid, set())
+        for nid in locs:
+            if nid == self.head_node_id:
+                self._store.delete(oid)
+            else:
+                node = self.nodes.get(nid)
+                if node is not None and node.conn is not None:
+                    asyncio.get_running_loop().create_task(
+                        node.conn.send(MsgType.OBJECT_DELETE, {"object_ids": [oid]})
+                    )
+        # even with no recorded location (pre-location legacy puts), try head
+        if not locs:
+            self._store.delete(oid)
+
     async def h_free_object(self, cid, conn, p):
         for oid in p["object_ids"]:
             self.objects.pop(oid, None)
-            self._store.delete(oid)
+            self._delete_everywhere(oid)
         return {"ok": True}
 
     async def h_add_ref(self, cid, conn, p):
@@ -638,7 +778,7 @@ class HeadServer:
             self.object_refcounts.pop(oid, None)
             # out of scope everywhere → evictable; delete eagerly
             self.objects.pop(oid, None)
-            self._store.delete(oid)
+            self._delete_everywhere(oid)
         else:
             self.object_refcounts[oid] = n
 
@@ -698,9 +838,18 @@ class HeadServer:
 
     async def h_task_done(self, cid, conn, p):
         tid = p["task_id"]
-        entry = self.tasks.pop(tid, None)
         wid = self._conn_worker.get(cid)
         w = self.workers.get(wid) if wid else None
+        if wid is not None and w is None:
+            # Zombie report: this worker was already declared dead (its node
+            # was removed — SIGKILLed raylets don't reap their workers) and
+            # its task has been retried or failed.  Sealing from here would
+            # record data on a dead node's store segment; drop it and cut
+            # the connection so the orphan exits.
+            logger.info("dropping TASK_DONE from de-registered worker %s", wid.hex()[:8])
+            conn.close()
+            return {"ok": False, "stale": True}
+        entry = self.tasks.pop(tid, None)
         if w is not None:
             w.running_tasks.discard(tid)
         self.finished_task_count += 1
@@ -748,7 +897,9 @@ class HeadServer:
             if entry is not None:
                 await self._seal_error_objects(entry.spec, p["error"])
         else:
+            seal_nid = w.node_id if w is not None else self._conn_node.get(cid)
             for oid in p.get("sealed", []):
+                self._add_location(bytes(oid), seal_nid)
                 await self._seal_object(oid)
         self._kick_scheduler()
         return {"ok": True}

@@ -2,15 +2,11 @@
 
 Analog of the reference's raylet binary (reference: src/ray/raylet/main.cc +
 worker_pool.cc): registers the node with the head, spawns worker processes
-on demand, and supervises them.  Scheduling decisions live in the head
-(see gcs/server.py); this agent is the node-local arm that executes
-spawn/kill directives — the WorkerPool half of the reference raylet.
-
-Round-1 simplification: nodes of one cluster share the head's shm store
-segment (all test "nodes" are processes on one machine, the same shape as
-the reference's cluster_utils harness, python/ray/cluster_utils.py:99).
-True multi-host adds the object-transfer layer (reference:
-src/ray/object_manager/) on top of this agent in a later round.
+on demand, supervises them, and — since round 2 — owns the node's private
+shared-memory object store plus the transfer agent that moves objects
+between nodes (reference: src/ray/object_manager/object_manager.h).
+Scheduling decisions live in the head (see gcs/server.py); this agent is
+the node-local arm that executes spawn/kill/pull/delete directives.
 """
 
 from __future__ import annotations
@@ -24,6 +20,7 @@ import subprocess
 import sys
 from typing import List
 
+from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.protocol import Connection, MsgType
 
@@ -35,32 +32,42 @@ class Raylet:
         self.resources = resources
         self.session_dir = session_dir
         self.node_id = NodeID.from_random()
-        self.store_path = ""
+        self.store_path = os.path.join(session_dir, f"store-{self.node_id.hex()[:8]}")
         self.worker_procs: List[subprocess.Popen] = []
         self._worker_seq = 0
+        self.store = None
+        self.object_agent = None
 
     async def run(self):
+        from ray_tpu.core.shm_store import ShmObjectStore
+        from ray_tpu.raylet.object_agent import ObjectTransferAgent
+
+        # Per-node store segment: THIS is what makes multi-node real — data
+        # produced on this node lives here, and crossing nodes requires the
+        # transfer agent, exactly like plasma + object manager upstream.
+        self.store = ShmObjectStore(
+            self.store_path, capacity=RayConfig.object_store_memory, create=True
+        )
+        self.object_agent = ObjectTransferAgent(self.store)
+        transfer_port = await self.object_agent.start()
+        advertise = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+
         conn = await Connection.connect(self.head_host, self.head_port)
         self.conn = conn
-        # The head replies with its node's store path via REGISTER_JOB-style
-        # info; for now we register and receive ours from the head's reply.
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
         reply = await conn.request(
             MsgType.REGISTER_NODE,
             {
                 "node_id": self.node_id.binary(),
                 "resources": self.resources,
-                "store_path": self._head_store_path(),
-                "address": "127.0.0.1",
+                "store_path": self.store_path,
+                "address": advertise,
+                "transfer_addr": f"{advertise}:{transfer_port}",
             },
         )
         assert reply.get("ok")
         print(f"NODE {self.node_id.hex()}", flush=True)
         await reply_fut
-
-    def _head_store_path(self) -> str:
-        # shared-store simplification: all local nodes use the head's segment
-        return os.path.join(self.session_dir, "store")
 
     async def _read_loop(self, conn: Connection):
         try:
@@ -70,17 +77,36 @@ class Raylet:
                     continue
                 if msg_type == MsgType.PUSH_TASK and payload.get("directive") == "spawn_worker":
                     self._spawn_worker(tpu=bool(payload.get("tpu")))
+                elif msg_type == MsgType.OBJECT_PULL:
+                    asyncio.get_running_loop().create_task(
+                        self._handle_pull(conn, rid, payload)
+                    )
+                elif msg_type == MsgType.OBJECT_DELETE:
+                    for oid in payload.get("object_ids", []):
+                        self.store.delete(bytes(oid))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
-            self.kill_workers()
+            self.shutdown()
+
+    async def _handle_pull(self, conn: Connection, rid: int, payload: dict):
+        oid = bytes(payload["object_id"])
+        src = payload["src_addr"]
+        try:
+            ok = await asyncio.wait_for(self.object_agent.pull(oid, src), timeout=300)
+            await conn.reply(rid, {"ok": bool(ok)})
+        except Exception as e:  # noqa: BLE001
+            try:
+                await conn.reply(rid, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
 
     def _spawn_worker(self, tpu: bool = False):
         self._worker_seq += 1
         env = dict(os.environ)
         env["RAY_TPU_HEAD"] = f"{self.head_host}:{self.head_port}"
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        env["RAY_TPU_STORE_PATH"] = self._head_store_path()
+        env["RAY_TPU_STORE_PATH"] = self.store_path
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
             env.pop("JAX_PLATFORMS", None)
@@ -107,6 +133,23 @@ class Raylet:
             except OSError:
                 pass
 
+    def shutdown(self):
+        self.kill_workers()
+        try:
+            if self.object_agent is not None:
+                self.object_agent.stop()
+        except Exception:
+            pass
+        try:
+            if self.store is not None:
+                self.store.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
 
 def main():
     parser = argparse.ArgumentParser()
@@ -118,14 +161,14 @@ def main():
     raylet = Raylet(host, int(port), json.loads(args.resources), args.session_dir)
 
     def _term(signum, frame):
-        raylet.kill_workers()
+        raylet.shutdown()
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, _term)
     try:
         asyncio.run(raylet.run())
     except KeyboardInterrupt:
-        raylet.kill_workers()
+        raylet.shutdown()
 
 
 if __name__ == "__main__":
