@@ -40,6 +40,7 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "task_max_retries": (int, 3, "default retries for normal tasks"),
     "actor_max_restarts": (int, 0, "default restarts for actors"),
     "lineage_max_bytes": (int, 64 * 1024 * 1024, "max lineage kept per owner for reconstruction"),
+    "max_object_reconstructions": (int, 3, "re-executions allowed to recover a lost object"),
     # -- collective / tpu --
     "collective_rendezvous_timeout_s": (float, 120.0, "GCS-KV rendezvous wait"),
     "dcn_allreduce_chunk_bytes": (int, 4 * 1024 * 1024, "ring-allreduce chunk over DCN"),

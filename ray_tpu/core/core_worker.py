@@ -302,11 +302,34 @@ class CoreWorker:
                         raise _error_from_string(reply.get("error", "task failed"))
                     sobj = self.store.get_serialized(oid)
                     if sobj is None:
-                        raise ObjectLostError(oid.hex(), "sealed but missing from store (evicted?)")
+                        sobj = self._refetch_evicted(oid, deadline)
                     out[i] = self._materialize(sobj)
             finally:
                 self._notify_blocked(False)
         return out
+
+    def _refetch_evicted(self, oid: bytes, deadline: Optional[float]) -> SerializedObject:
+        """The head said sealed but the local store misses it (LRU evicted
+        under us).  Report the stale location; the head re-pulls from
+        another copy or reconstructs from lineage."""
+        for _ in range(2):
+            rem = None
+            if deadline is not None:
+                rem = max(0.0, deadline - time.monotonic())
+            reply = self.request(
+                MsgType.WAIT_OBJECT,
+                {"object_id": oid, "timeout": rem, "node_id": self.node_id, "evicted": True},
+                timeout=(rem + 5) if rem is not None else 3600,
+            )
+            state = reply.get("state")
+            if state == "timeout":
+                raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
+            if state == "error":
+                raise _error_from_string(reply.get("error", "object lost"))
+            sobj = self.store.get_serialized(oid)
+            if sobj is not None:
+                return sobj
+        raise ObjectLostError(oid.hex(), "sealed but repeatedly missing from local store")
 
     def _materialize(self, sobj: SerializedObject) -> Any:
         value = serialization.deserialize(sobj)

@@ -261,6 +261,13 @@ class HeadServer:
         self.object_locations: Dict[bytes, set] = {}
         # (oid, dest_node) -> future, coalescing concurrent pull requests
         self._pull_inflight: Dict[Tuple[bytes, bytes], asyncio.Future] = {}
+        # lineage: return oid -> producing TaskSpec, byte-budgeted FIFO
+        # (analog: reference TaskManager lineage pinning, task_manager.h:91-105
+        # + ObjectRecoveryManager, object_recovery_manager.h:90)
+        self.lineage: Dict[bytes, TaskSpec] = {}
+        self._lineage_bytes: Dict[bytes, int] = {}
+        self._lineage_total = 0
+        self._reconstructions: Dict[bytes, int] = {}
 
         self.kv: Dict[str, bytes] = {}
         # pubsub: channel -> {conn_id: Connection}
@@ -689,28 +696,49 @@ class HeadServer:
         oid = p["object_id"]
         timeout = p.get("timeout")
         deadline = time.time() + timeout if timeout is not None else None
-        e = self._object_entry(oid)
-        if e[0] == PENDING:
-            fut = asyncio.get_running_loop().create_future()
-            self.object_waiters.setdefault(oid, []).append(fut)
-            try:
-                await asyncio.wait_for(fut, timeout)
-            except asyncio.TimeoutError:
-                return {"state": "timeout"}
-        e = self.objects[oid]
-        if e[0] == ERRORED:
-            return {"state": "error", "error": e[1]}
-        # cross-node data plane: fetch the object onto the waiter's node
-        # within what's left of the caller's deadline
-        dest_nid = p.get("node_id")
-        if dest_nid is not None:
+        dest_nid = bytes(p["node_id"]) if p.get("node_id") is not None else None
+        if p.get("evicted") and dest_nid is not None:
+            # client found the object missing from its local store after a
+            # sealed reply: that location is stale (LRU-evicted)
+            locs = self.object_locations.get(oid)
+            if locs is not None:
+                locs.discard(dest_nid)
+                if not locs:
+                    del self.object_locations[oid]
+        while True:
+            e = self._object_entry(oid)
+            if e[0] == PENDING:
+                fut = asyncio.get_running_loop().create_future()
+                self.object_waiters.setdefault(oid, []).append(fut)
+                rem = None if deadline is None else max(0.001, deadline - time.time())
+                try:
+                    await asyncio.wait_for(fut, rem)
+                except asyncio.TimeoutError:
+                    return {"state": "timeout"}
+            e = self.objects[oid]
+            if e[0] == ERRORED:
+                return {"state": "error", "error": e[1]}
+            if dest_nid is None:
+                return {"state": "sealed"}
+            # cross-node data plane: fetch the object onto the waiter's node
+            # within what's left of the caller's deadline
             rem = None if deadline is None else max(0.001, deadline - time.time())
-            err = await self._ensure_object_local(oid, bytes(dest_nid), timeout=rem)
+            err = await self._ensure_object_local(oid, dest_nid, timeout=rem)
+            if err is None:
+                return {"state": "sealed"}
             if err == "__timeout__":
                 return {"state": "timeout"}
-            if err is not None:
+            if not err.startswith("ObjectLostError"):
+                # dest-side or unexpected transfer error while source copies
+                # may be healthy: report it, do NOT wipe valid locations
                 return {"state": "error", "error": err}
-        return {"state": "sealed"}
+            # every copy is gone (eviction / node loss): lineage recovery
+            # (analog: reference object_recovery_manager.h:90), then loop
+            # back to wait for the re-executed task to seal
+            self.object_locations.pop(oid, None)
+            rec_err = self._reconstruct_object(oid)
+            if rec_err is not None:
+                return {"state": "error", "error": err + "; " + rec_err}
 
     async def _wait_batch(self, p):
         """Server-side ray.wait: block until num_ready of the ids are
@@ -765,6 +793,13 @@ class HeadServer:
             self.object_refcounts[oid] = self.object_refcounts.get(oid, 0) + 1
         return {"ok": True}
 
+    def _pin_args(self, spec: TaskSpec):
+        """Bump refcounts of ARG_REF arguments (inverse of _unpin_args)."""
+        for arg in spec.args:
+            if arg[0] == 1:  # ARG_REF
+                aid = bytes(arg[2])
+                self.object_refcounts[aid] = self.object_refcounts.get(aid, 0) + 1
+
     def _unpin_args(self, spec: TaskSpec):
         """Release the submit-time pins on ARG_REF arguments (paired with
         the bump in h_submit_task)."""
@@ -779,8 +814,79 @@ class HeadServer:
             # out of scope everywhere → evictable; delete eagerly
             self.objects.pop(oid, None)
             self._delete_everywhere(oid)
+            # nobody can ever get() it again → its lineage is dead too
+            self._drop_lineage(oid)
+            self._reconstructions.pop(oid, None)
         else:
             self.object_refcounts[oid] = n
+
+    # --------------------------------------------------- lineage / recovery
+
+    def _record_lineage(self, spec: TaskSpec, wire_size: int):
+        """Remember the producing spec for each return object, pinning the
+        spec's ref-args so reconstruction inputs can't be deleted while the
+        lineage is held.  FIFO-evicted beyond lineage_max_bytes; the spec's
+        size is charged once per task, not once per return."""
+        charged = False
+        for oid in spec.return_object_ids():
+            if oid in self.lineage:
+                charged = True  # already recorded for this task
+                continue
+            self.lineage[oid] = spec
+            self._lineage_bytes[oid] = 0 if charged else wire_size
+            if not charged:
+                self._lineage_total += wire_size
+                charged = True
+            self._pin_args(spec)
+        budget = RayConfig.lineage_max_bytes
+        while self._lineage_total > budget and self.lineage:
+            evict = next(iter(self.lineage))
+            self._drop_lineage(evict)
+
+    def _drop_lineage(self, oid: bytes):
+        spec = self.lineage.pop(oid, None)
+        if spec is None:
+            return
+        self._lineage_total -= self._lineage_bytes.pop(oid, 0)
+        for arg in spec.args:
+            if arg[0] == 1:
+                self._dec_ref(bytes(arg[2]))
+
+    def _reconstruct_object(self, oid: bytes) -> Optional[str]:
+        """Queue re-execution of the producing task for a lost object.
+        Returns None if reconstruction is underway, else an error string
+        (analog: reference ObjectRecoveryManager::RecoverObject)."""
+        spec = self.lineage.get(oid)
+        if spec is None:
+            return f"ObjectLostError: {oid.hex()[:16]} lost and no lineage retained"
+        n = self._reconstructions.get(oid, 0)
+        if n >= RayConfig.max_object_reconstructions:
+            return (
+                f"ObjectLostError: {oid.hex()[:16]} lost after "
+                f"{n} reconstruction attempts"
+            )
+        # every return object of the re-executed task becomes pending again
+        for roid in spec.return_object_ids():
+            if not self.object_locations.get(roid):
+                e = self._object_entry(roid)
+                e[0] = PENDING
+                e[1] = None
+        if spec.task_id not in self.tasks:
+            # the attempt budget is consumed only by an actual re-execution —
+            # concurrent waiters piggyback on the in-flight one for free
+            self._reconstructions[oid] = n + 1
+            logger.info(
+                "reconstructing %s via re-execution of %s",
+                oid.hex()[:16],
+                spec.function_name,
+            )
+            # re-pin args exactly like a fresh submit (task_done unpins)
+            self._pin_args(spec)
+            entry = TaskEntry(spec, -1)
+            self.tasks[spec.task_id] = entry
+            self.task_queue.append(entry)
+            self._kick_scheduler()
+        return None
 
     async def h_remove_ref(self, cid, conn, p):
         for oid in p["object_ids"]:
@@ -795,12 +901,23 @@ class HeadServer:
             self._object_entry(oid)
         # pin ref-args until the task completes so an eager driver-side
         # del doesn't free an argument out from under the task
-        for arg in spec.args:
-            if arg[0] == 1:  # ARG_REF
-                oid = bytes(arg[2])
-                self.object_refcounts[oid] = self.object_refcounts.get(oid, 0) + 1
+        self._pin_args(spec)
         if spec.task_type == ACTOR_TASK:
             return await self._submit_actor_task(spec)
+        if spec.task_type == NORMAL_TASK:
+            # cheap size estimate for the lineage budget (re-serializing the
+            # spec on the submit hot path would double the encode cost)
+            est = 256
+            for a in spec.args:
+                pay = a[2]
+                if isinstance(pay, (bytes, bytearray, memoryview)):
+                    est += len(pay)  # ARG_REF: object id
+                elif isinstance(pay, (list, tuple)) and len(pay) == 3:
+                    # ARG_VALUE wire form: [metadata, inband, buffers]
+                    est += len(pay[1]) + sum(len(b) for b in pay[2])
+                else:
+                    est += 64
+            self._record_lineage(spec, est)
         entry = TaskEntry(spec, cid)
         self.tasks[spec.task_id] = entry
         self.task_queue.append(entry)
