@@ -84,6 +84,29 @@ class Cluster:
                 break
         raise RuntimeError(f"cluster head failed to start (see {self.session_dir}/head.log)")
 
+    def kill_head(self, force: bool = True):
+        """Crash the head process (SIGKILL by default — simulates head
+        failure; the GCS snapshot in the session dir survives)."""
+        if self.head_proc is not None:
+            try:
+                if force:
+                    self.head_proc.kill()
+                else:
+                    self.head_proc.terminate()
+                self.head_proc.wait(timeout=10)
+            except Exception:
+                pass
+            self.head_proc = None
+
+    def restart_head(self, head_node_args: Optional[Dict] = None):
+        """Start a fresh head in the SAME session dir: it restores the GCS
+        snapshot (detached actors, PGs, KV, jobs) — the head-FT story
+        (reference analog: GCS restart against Redis +
+        HandleNotifyGCSRestart, node_manager.cc:1161)."""
+        self.kill_head()
+        self._start_head(head_node_args or {})
+        return self.address
+
     def add_node(
         self,
         num_cpus: float = 4,

@@ -141,6 +141,11 @@ class CoreWorker:
         self.store: Optional[ShmObjectStore] = None
         self.io.spawn(self._read_loop())
         self.io.spawn(self._gc_flush_loop())
+        if mode == "worker":
+            # liveness beacon: a SIGSTOPped/hung worker keeps its TCP socket
+            # open, so the head needs missed-beat detection to re-schedule
+            # its tasks (analog: reference gcs_heartbeat_manager.h)
+            self.io.spawn(self._heartbeat_loop())
         self.connected = True
         if mode == "driver":
             self.register_as_driver(worker_env or {})
@@ -175,6 +180,17 @@ class CoreWorker:
                     self._push_task_handler({"cancel": payload.get("task_id")})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             self.connected = False
+
+    async def _heartbeat_loop(self):
+        period = RayConfig.heartbeat_period_ms / 1000.0
+        try:
+            while True:
+                await asyncio.sleep(period)
+                await self.conn.send(
+                    MsgType.HEARTBEAT, {"worker_id": self.worker_id.binary()}
+                )
+        except (ConnectionError, OSError):
+            pass
 
     async def _gc_flush_loop(self):
         while True:

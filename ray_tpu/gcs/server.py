@@ -283,12 +283,15 @@ class HeadServer:
         self.timeline: "deque" = deque(maxlen=10000)
 
         self._conn_seq = 0
+        self._last_beat: Dict[int, float] = {}
         self._conns: Dict[int, Connection] = {}
         self._conn_kind: Dict[int, str] = {}  # driver|worker|raylet
         self._conn_worker: Dict[int, bytes] = {}
         self._conn_node: Dict[int, bytes] = {}
         self._sched_wakeup = asyncio.Event()
         self._shutdown = False
+        self._storage = None
+        self._tables_dirty = False
         self._worker_env: Dict[str, str] = {}
         self._next_worker_seq = 0
 
@@ -323,13 +326,28 @@ class HeadServer:
 
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # table persistence: restore surviving metadata from a prior head
+        # incarnation (detached actors restart on fresh workers), then keep
+        # snapshotting (analog: reference gcs_table_storage.h → Redis)
+        from ray_tpu.gcs.storage import GcsSnapshotStorage
+
+        self._storage = GcsSnapshotStorage(os.path.join(self.session_dir, "gcs_snapshot.pkl"))
+        self._restore_tables()
+
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._idle_reaper_loop())
+        asyncio.get_running_loop().create_task(self._failure_detector_loop())
+        asyncio.get_running_loop().create_task(self._persist_loop())
         logger.info("head server listening on %s:%d", self.host, self.port)
         return self.port
 
     async def stop(self):
         self._shutdown = True
+        if self._storage is not None:
+            try:
+                self._storage.save(self._snapshot_tables())
+            except Exception:
+                pass
         # kill all worker processes we know about
         for w in list(self.workers.values()):
             try:
@@ -348,6 +366,75 @@ class HeadServer:
             self._store.close()
         except Exception:
             pass
+
+    # ------------------------------------------------------- table snapshots
+
+    def _mark_tables_dirty(self):
+        self._tables_dirty = True
+
+    def _snapshot_tables(self) -> dict:
+        detached = []
+        for actor in self.actors.values():
+            if actor.detached and actor.state != ACTOR_DEAD:
+                detached.append(actor.creation_spec.to_wire())
+        pgs = [
+            (pg.pg_id, pg.bundles, pg.strategy, pg.name)
+            for pg in self.pgs.values()
+            if pg.state != "REMOVED"
+        ]
+        return {
+            "kv": dict(self.kv),
+            "jobs": dict(self.jobs),
+            "detached_actors": detached,
+            "pgs": pgs,
+        }
+
+    def _restore_tables(self):
+        snap = self._storage.load()
+        if not snap:
+            return
+        self.kv.update(snap.get("kv", {}))
+        self.jobs.update(snap.get("jobs", {}))
+        for wire in snap.get("detached_actors", []):
+            spec = TaskSpec.from_wire(wire)
+            if spec.actor_id in self.actors:
+                continue
+            actor = ActorInfo(spec)
+            actor.owner_conn_id = -1  # detached: owned by the cluster
+            self.actors[spec.actor_id] = actor
+            if spec.name:
+                self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
+            for oid in spec.return_object_ids():
+                self._object_entry(oid)
+            # old worker processes died with the previous head; re-run the
+            # creation task on a fresh worker (actor restart semantics)
+            entry = TaskEntry(spec, -1)
+            self.tasks[spec.task_id] = entry
+            self.task_queue.append(entry)
+        for pg_id, bundles, strategy, name in snap.get("pgs", []):
+            if pg_id in self.pgs:
+                continue
+            self.pgs[pg_id] = PlacementGroupInfo(pg_id, bundles, strategy, name)
+        if snap.get("detached_actors") or snap.get("pgs"):
+            logger.info(
+                "restored %d detached actors, %d placement groups from snapshot",
+                len(snap.get("detached_actors", [])),
+                len(snap.get("pgs", [])),
+            )
+
+    async def _persist_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            if not self._tables_dirty:
+                continue
+            self._tables_dirty = False
+            try:
+                snap = self._snapshot_tables()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._storage.save, snap
+                )
+            except Exception:
+                logger.exception("GCS snapshot failed")
 
     # ----------------------------------------------------------- connections
 
@@ -369,6 +456,7 @@ class HeadServer:
             pass
         finally:
             self._conns.pop(cid, None)
+            self._last_beat.pop(cid, None)
             conn.close()
             await self._on_disconnect(cid)
 
@@ -436,6 +524,7 @@ class HeadServer:
         self._conn_kind[cid] = "driver"
         job_id = p.get("job_id", b"")
         self.jobs[job_id] = {"started_at": time.time(), "driver_pid": p.get("pid", 0)}
+        self._mark_tables_dirty()
         self._worker_env.update(p.get("worker_env") or {})
         return {
             "ok": True,
@@ -444,7 +533,56 @@ class HeadServer:
         }
 
     async def h_heartbeat(self, cid, conn, p):
+        self._last_beat[cid] = time.time()
         return {"ok": True, "t": time.time()}
+
+    async def _failure_detector_loop(self):
+        """Missed-beat expiry for raylets and workers: TCP staying open is
+        not liveness — a SIGSTOPped process holds its socket forever.
+        Analog: reference GcsHeartbeatManager (gcs_heartbeat_manager.h,
+        30 missed beats ⇒ dead per ray_config_def.h:56-59)."""
+        period = RayConfig.heartbeat_period_ms / 1000.0
+        window = period * RayConfig.num_heartbeats_timeout
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            now = time.time()
+            for cid, kind in list(self._conn_kind.items()):
+                if kind not in ("raylet", "worker"):
+                    continue
+                last = self._last_beat.get(cid)
+                if last is None:
+                    self._last_beat[cid] = now  # grace from first sighting
+                    continue
+                if now - last <= window:
+                    continue
+                conn = self._conns.get(cid)
+                if kind == "raylet":
+                    nid = self._conn_node.get(cid)
+                    logger.warning(
+                        "node %s missed heartbeats for %.1fs — declaring dead",
+                        nid.hex()[:8] if nid else "?",
+                        now - last,
+                    )
+                    self._conn_kind.pop(cid, None)
+                    self._conn_node.pop(cid, None)
+                    if nid:
+                        await self._on_node_dead(nid)
+                else:
+                    wid = self._conn_worker.get(cid)
+                    logger.warning(
+                        "worker %s missed heartbeats for %.1fs — declaring dead",
+                        wid.hex()[:8] if wid else "?",
+                        now - last,
+                    )
+                    self._conn_kind.pop(cid, None)
+                    self._conn_worker.pop(cid, None)
+                    if wid:
+                        await self._on_worker_dead(
+                            wid, f"missed heartbeats for {now - last:.1f}s"
+                        )
+                self._last_beat.pop(cid, None)
+                if conn is not None:
+                    conn.close()
 
     async def _on_node_dead(self, nid: bytes):
         node = self.nodes.get(nid)
@@ -552,6 +690,8 @@ class HeadServer:
         self._kick_scheduler()
 
     async def _destroy_actor(self, actor: ActorInfo, reason: str):
+        if actor.detached:
+            self._mark_tables_dirty()
         if actor.state == ACTOR_DEAD:
             return
         actor.state = ACTOR_DEAD
@@ -1081,6 +1221,8 @@ class HeadServer:
         self.actors[spec.actor_id] = actor
         if spec.name:
             self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
+        if spec.detached:
+            self._mark_tables_dirty()
         for oid in spec.return_object_ids():
             self._object_entry(oid)
         entry = TaskEntry(spec, cid)
@@ -1146,6 +1288,7 @@ class HeadServer:
     async def h_create_pg(self, cid, conn, p):
         pg = PlacementGroupInfo(p["pg_id"], p["bundles"], p["strategy"], p.get("name", ""))
         self.pgs[pg.pg_id] = pg
+        self._mark_tables_dirty()
         self._try_place_pg(pg)
         self._kick_scheduler()
         return {"ok": True, "placed": pg.state == "CREATED"}
@@ -1246,6 +1389,7 @@ class HeadServer:
             return {"ready": False}
 
     async def h_remove_pg(self, cid, conn, p):
+        self._mark_tables_dirty()
         pg = self.pgs.pop(p["pg_id"], None)
         if pg is None:
             return {"ok": False}
@@ -1282,6 +1426,7 @@ class HeadServer:
     # ------------------------------------------------------------- KV/pubsub
 
     async def h_kv_put(self, cid, conn, p):
+        self._mark_tables_dirty()
         key = p["key"]
         if p.get("overwrite", True) or key not in self.kv:
             self.kv[key] = p["value"]
@@ -1300,6 +1445,7 @@ class HeadServer:
         return {"found": v is not None, "value": v if v is not None else b""}
 
     async def h_kv_del(self, cid, conn, p):
+        self._mark_tables_dirty()
         n = 0
         if p.get("prefix"):
             for k in [k for k in self.kv if k.startswith(p["key"])]:

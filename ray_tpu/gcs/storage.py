@@ -1,0 +1,47 @@
+"""GCS table persistence.
+
+Analog of the reference's pluggable GCS storage
+(reference: src/ray/gcs/gcs_server/gcs_table_storage.h over
+store_client/redis_store_client.h:28 or in_memory_store_client.h:31).
+This runtime's equivalent of "Redis mode" is a crash-consistent snapshot
+file in the session dir: cluster metadata (KV, jobs, detached actors,
+placement groups) survives a head restart, so detached actors are
+re-reachable and get restarted on fresh workers — the head-FT behavior
+the reference gets from HandleNotifyGCSRestart + Redis-backed tables.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+
+class GcsSnapshotStorage:
+    """Atomic write-then-rename snapshot of the GCS tables."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, tables: Dict[str, Any]):
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(tables, f, protocol=5)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None  # torn/corrupt snapshot: start fresh
+
+    def delete(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

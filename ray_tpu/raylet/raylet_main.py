@@ -55,6 +55,7 @@ class Raylet:
         conn = await Connection.connect(self.head_host, self.head_port)
         self.conn = conn
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
+        asyncio.get_running_loop().create_task(self._heartbeat_loop(conn))
         reply = await conn.request(
             MsgType.REGISTER_NODE,
             {
@@ -68,6 +69,19 @@ class Raylet:
         assert reply.get("ok")
         print(f"NODE {self.node_id.hex()}", flush=True)
         await reply_fut
+
+    async def _heartbeat_loop(self, conn: Connection):
+        """Periodic liveness beacon.  The head declares this node dead after
+        num_heartbeats_timeout missed beats — TCP staying open is NOT enough
+        (a SIGSTOPped or wedged raylet keeps its socket alive forever).
+        Analog: reference gcs_heartbeat_manager.h."""
+        period = RayConfig.heartbeat_period_ms / 1000.0
+        try:
+            while True:
+                await asyncio.sleep(period)
+                await conn.send(MsgType.HEARTBEAT, {"node_id": self.node_id.binary()})
+        except (ConnectionError, OSError):
+            pass
 
     async def _read_loop(self, conn: Connection):
         try:

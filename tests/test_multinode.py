@@ -118,3 +118,47 @@ def test_cross_node_object_transfer(cluster):
     big = ray_tpu.get(produce.remote(), timeout=120)[0]
     assert big.shape == (3_000_000,)
     assert float(big[-1]) == 2_999_999.0
+
+
+def test_hung_node_declared_dead_by_heartbeat_timeout(monkeypatch):
+    """A SIGSTOPped raylet keeps its TCP socket open, so death must come
+    from missed heartbeats, not disconnect (reference analog:
+    gcs_heartbeat_manager.h, 30 missed beats => dead)."""
+    import os
+    import signal as sig
+
+    # shrink the window BEFORE the head subprocess starts (it reads env)
+    monkeypatch.setenv("RAY_TPU_HEARTBEAT_PERIOD_MS", "200")
+    monkeypatch.setenv("RAY_TPU_NUM_HEARTBEATS_TIMEOUT", "8")
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        node = c.add_node(num_cpus=1, resources={"hb": 1.0})
+
+        @ray_tpu.remote(resources={"hb": 1.0}, max_retries=2)
+        def job():
+            return "ran"
+
+        assert ray_tpu.get(job.remote(), timeout=60) == "ran"
+        assert any(n["NodeID"] == node.node_id for n in ray_tpu.nodes())
+
+        os.kill(node.proc.pid, sig.SIGSTOP)
+        try:
+            deadline = time.time() + 20  # window is 200ms * 8 = 1.6s
+            while time.time() < deadline:
+                if not any(n["NodeID"] == node.node_id for n in ray_tpu.nodes()):
+                    break
+                time.sleep(0.3)
+            assert not any(
+                n["NodeID"] == node.node_id for n in ray_tpu.nodes()
+            ), "hung node was never declared dead"
+            # and its exclusive resource demand is now servable elsewhere
+            c.add_node(num_cpus=1, resources={"hb": 1.0})
+            assert ray_tpu.get(job.remote(), timeout=60) == "ran"
+        finally:
+            os.kill(node.proc.pid, sig.SIGCONT)
+            c.remove_node(node, allow_graceful=False)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
