@@ -82,8 +82,13 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()[:16]}…)"
 
     def __reduce__(self):
-        # Crossing a process boundary: the receiver holds a borrowed ref
-        # (no local refcount bump until it lands in a live core worker).
+        # Crossing a process boundary: report the id to the active serialize()
+        # capture so the shipping control message pins it at the head until
+        # the receiver registers its own ref (borrower protocol; reference
+        # analog: reference_count.cc WrapObjectIds / borrower bookkeeping).
+        from ray_tpu._private.serialization import record_contained_ref
+
+        record_contained_ref(self._id)
         return (_rebuild_ref, (self._id,))
 
     def __del__(self):

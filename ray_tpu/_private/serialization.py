@@ -13,6 +13,7 @@ from __future__ import annotations
 import copyreg
 import pickle
 import sys
+import threading
 from dataclasses import dataclass, field
 from typing import Any, List, Sequence
 
@@ -26,6 +27,40 @@ META_TASK_ERROR = b"err"
 META_ACTOR_HANDLE = b"actor"
 
 _jax_reducer_installed = False
+
+# Contained-ref capture: while serialize() runs, ObjectRef.__reduce__ records
+# every ref pickled into the payload here.  The ids ride the control message
+# that ships the payload (PUT_OBJECT / TaskSpec.nested_refs / TASK_DONE), so
+# the head can pin inner objects for as long as their container is in scope —
+# the owner-centralized form of the reference's borrower protocol
+# (reference: src/ray/core_worker/reference_count.cc), which exists to close
+# the window where the sender releases a shipped ref before the receiver has
+# registered its own.
+_capture = threading.local()
+
+
+def _begin_ref_capture() -> list:
+    stack = getattr(_capture, "stack", None)
+    if stack is None:
+        stack = _capture.stack = []
+    frame: list = []
+    stack.append(frame)
+    return frame
+
+
+def _end_ref_capture(frame: list) -> List[bytes]:
+    stack = getattr(_capture, "stack", None)
+    if stack and stack[-1] is frame:
+        stack.pop()
+    # dedup, keep order
+    return list(dict.fromkeys(frame))
+
+
+def record_contained_ref(oid: bytes):
+    """Called by ObjectRef.__reduce__ during an active serialize()."""
+    stack = getattr(_capture, "stack", None)
+    if stack:
+        stack[-1].append(oid)
 
 
 def _maybe_install_jax_reducer():
@@ -65,6 +100,9 @@ class SerializedObject:
     metadata: bytes
     inband: bytes
     buffers: List[memoryview] = field(default_factory=list)
+    # ObjectRef ids pickled inside this value (borrower pinning; not on the
+    # data-plane wire — shipped via the control message that moves the value)
+    contained: List[bytes] = field(default_factory=list)
 
     def total_bytes(self) -> int:
         return len(self.inband) + sum(b.nbytes for b in self.buffers)
@@ -84,7 +122,11 @@ def serialize(value: Any) -> SerializedObject:
     if isinstance(value, bytes):
         return SerializedObject(META_RAW, b"", [memoryview(value)])
     buffers: List[pickle.PickleBuffer] = []
-    inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    frame = _begin_ref_capture()
+    try:
+        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    finally:
+        contained = _end_ref_capture(frame)
     views = []
     for pb in buffers:
         try:
@@ -92,7 +134,7 @@ def serialize(value: Any) -> SerializedObject:
         except BufferError:
             # non-contiguous buffer: force a contiguous copy
             views.append(memoryview(bytes(pb)))
-    return SerializedObject(META_PICKLE, inband, views)
+    return SerializedObject(META_PICKLE, inband, views, contained)
 
 
 def deserialize(obj: SerializedObject) -> Any:

@@ -31,6 +31,10 @@ class TaskSpec:
     method_name: str = ""  # actor tasks
     actor_id: Optional[bytes] = None
     args: List[list] = field(default_factory=list)  # [[ARG_VALUE, wire] | [ARG_REF, id]]
+    # object ids pickled INSIDE inlined ARG_VALUE payloads; the head pins
+    # these for the task's lifetime exactly like top-level ARG_REF args
+    # (borrower protocol, reference: reference_count.cc)
+    nested_refs: List[bytes] = field(default_factory=list)
     num_returns: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0

@@ -257,7 +257,7 @@ class CoreWorker:
 
     # --------------------------------------------------------------- objects
 
-    def put(self, value: Any) -> ObjectRef:
+    def _next_put_oid(self) -> bytes:
         with self._put_lock:
             self._put_counter += 1
             idx = self._put_counter
@@ -266,14 +266,22 @@ class CoreWorker:
             if self.current_task_id
             else TaskID.for_driver_task(self.job_id)
         )
-        oid = ObjectID.for_put(task_id, idx).binary()
+        return ObjectID.for_put(task_id, idx).binary()
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self._next_put_oid()
         self.put_object(oid, serialization.serialize(value))
         return ObjectRef(oid, self)
 
     def put_object(self, oid: bytes, sobj: SerializedObject):
         if not self.store.put_serialized(oid, sobj):
             pass  # already present (idempotent put)
-        self.request(MsgType.PUT_OBJECT, {"object_id": oid, "node_id": self.node_id})
+        # contained refs ride the seal message so the head pins the inner
+        # objects for the container's lifetime (borrower protocol)
+        self.request(
+            MsgType.PUT_OBJECT,
+            {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
+        )
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = time.monotonic() + timeout if timeout is not None else None
@@ -421,13 +429,15 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
+        encoded_args, nested_refs = self._encode_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
             task_type=NORMAL_TASK,
             function_id=function_id,
             function_name=function_name,
-            args=self._encode_args(args, kwargs),
+            args=encoded_args,
+            nested_refs=nested_refs,
             num_returns=num_returns,
             resources=resources,
             max_retries=max_retries,
@@ -461,6 +471,7 @@ class CoreWorker:
         from ray_tpu._private.ids import ActorID
 
         task_id = TaskID.for_actor_creation(ActorID(actor_id))
+        encoded_args, nested_refs = self._encode_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -468,7 +479,8 @@ class CoreWorker:
             function_id=function_id,
             function_name=class_name,
             actor_id=actor_id,
-            args=self._encode_args(args, kwargs),
+            args=encoded_args,
+            nested_refs=nested_refs,
             num_returns=1,
             resources=resources,
             max_restarts=max_restarts,
@@ -498,6 +510,7 @@ class CoreWorker:
         seq = self._actor_seq.get(actor_id, 0)
         self._actor_seq[actor_id] = seq + 1
         task_id = TaskID.for_actor_task(ActorID(actor_id))
+        encoded_args, nested_refs = self._encode_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -505,7 +518,8 @@ class CoreWorker:
             function_id=function_id,
             method_name=method_name,
             actor_id=actor_id,
-            args=self._encode_args(args, kwargs),
+            args=encoded_args,
+            nested_refs=nested_refs,
             num_returns=num_returns,
             seq_no=seq,
             caller_id=self.worker_id.binary(),
@@ -513,10 +527,15 @@ class CoreWorker:
         self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
 
-    def _encode_args(self, args: tuple, kwargs: dict) -> List[list]:
+    def _encode_args(self, args: tuple, kwargs: dict) -> Tuple[List[list], List[bytes]]:
         """Inline small values; put large ones in the store and pass refs
-        (reference: direct-call arg inlining, max_direct_call_object_size)."""
+        (reference: direct-call arg inlining, max_direct_call_object_size).
+
+        Also returns the ids of refs nested inside inlined ARG_VALUE
+        payloads: the submit message carries them so the head pins them for
+        the task's lifetime, exactly like top-level ARG_REF args."""
         encoded: List[list] = []
+        nested: List[bytes] = []
         limit = RayConfig.max_direct_call_object_size
         items = [(False, a) for a in args] + [(k, v) for k, v in kwargs.items()]
         for key, value in items:
@@ -526,10 +545,16 @@ class CoreWorker:
             sobj = serialization.serialize(value)
             if sobj.total_bytes() <= limit:
                 encoded.append([ARG_VALUE, key if key else None, sobj.to_wire()])
+                nested.extend(sobj.contained)
             else:
-                ref = self.put(value)
+                # large value → stored object, reusing the bytes already in
+                # hand; its contained refs are pinned by put_object for the
+                # stored container's lifetime
+                oid = self._next_put_oid()
+                self.put_object(oid, sobj)
+                ref = ObjectRef(oid, self)
                 encoded.append([ARG_REF, key if key else None, ref.binary()])
-        return encoded
+        return encoded, list(dict.fromkeys(nested))
 
     def decode_args(self, encoded: List[list]) -> Tuple[tuple, dict]:
         args: List[Any] = []
@@ -634,6 +659,7 @@ class CoreWorker:
         stored_error: bool,
         exec_start: float = 0.0,
         exec_end: float = 0.0,
+        contained: Optional[Dict[bytes, List[bytes]]] = None,
     ):
         self.io.call(
             self.conn.send(
@@ -645,6 +671,9 @@ class CoreWorker:
                     "stored_error": stored_error,
                     "exec_start": exec_start,
                     "exec_end": exec_end,
+                    # refs pickled inside each sealed return value → the head
+                    # pins them for the return object's lifetime
+                    "contained": contained or {},
                 },
             )
         )

@@ -77,6 +77,7 @@ class WorkerRuntime:
         import time as _time
 
         sealed: List[bytes] = []
+        contained: Dict[bytes, List[bytes]] = {}
         error: Optional[str] = None
         stored_error = False
         exec_start = _time.time()
@@ -89,8 +90,11 @@ class WorkerRuntime:
             results = self._execute(spec)
             outs = self._normalize_returns(spec, results)
             for oid, value in outs:
-                self.cw.store.put_serialized(oid, serialization.serialize(value))
+                sobj = serialization.serialize(value)
+                self.cw.store.put_serialized(oid, sobj)
                 sealed.append(oid)
+                if sobj.contained:
+                    contained[oid] = sobj.contained
         except BaseException as e:  # noqa: BLE001
             name = spec.function_name or spec.method_name
             if isinstance(e, RayTaskError):
@@ -101,8 +105,13 @@ class WorkerRuntime:
             # store the error as the value of every return object
             try:
                 for oid in spec.return_object_ids():
-                    self.cw.store.put_serialized(oid, serialization.serialize(err))
+                    sobj = serialization.serialize(err)
+                    self.cw.store.put_serialized(oid, sobj)
                     sealed.append(oid)
+                    if sobj.contained:
+                        # refs pickled inside the exception value need the
+                        # same containment pin as normal returns
+                        contained[oid] = sobj.contained
                 stored_error = True
             except BaseException:
                 stored_error = False
@@ -117,6 +126,7 @@ class WorkerRuntime:
                 stored_error,
                 exec_start=exec_start,
                 exec_end=_time.time(),
+                contained=contained,
             )
         except Exception:
             traceback.print_exc(file=sys.stderr)

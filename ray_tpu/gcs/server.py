@@ -256,6 +256,11 @@ class HeadServer:
         self.objects: Dict[bytes, List] = {}
         self.object_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self.object_refcounts: Dict[bytes, int] = {}
+        # container oid -> ids of refs pickled inside its value.  While the
+        # container is in scope its inner objects are pinned (one refcount
+        # each), closing the sender-releases-before-receiver-registers race
+        # (analog: reference reference_count.cc borrower/containment protocol)
+        self.object_contained: Dict[bytes, List[bytes]] = {}
         # oid -> set of node_ids holding a sealed copy (analog: reference
         # OwnershipBasedObjectDirectory location sets)
         self.object_locations: Dict[bytes, set] = {}
@@ -270,6 +275,7 @@ class HeadServer:
         self._reconstructions: Dict[bytes, int] = {}
 
         self.kv: Dict[str, bytes] = {}
+        self._kv_waiters: Dict[str, List[asyncio.Future]] = {}
         # pubsub: channel -> {conn_id: Connection}
         self.subscribers: Dict[str, Dict[int, Connection]] = {}
 
@@ -641,7 +647,10 @@ class HeadServer:
             ):
                 self._release_task_resources(node, entry.spec)
             if entry.spec.task_type == ACTOR_CREATION_TASK:
-                continue  # actor FSM handles it below
+                # actor FSM handles restart/destroy below; balance the
+                # submit-time pin here (the restart path re-pins)
+                self._unpin_args(entry.spec)
+                continue
             if entry.spec.retries_left > 0:
                 entry.spec.retries_left -= 1
                 entry.state = "QUEUED"
@@ -675,6 +684,10 @@ class HeadServer:
             actor.restarts_used += 1
             actor.state = ACTOR_RESTARTING
             spec = actor.creation_spec
+            # re-pin exactly like a fresh submit: the restarted creation
+            # task's h_task_done will unpin again (without this, restart
+            # underflows the arg refcounts and deletes live objects)
+            self._pin_args(spec)
             entry = TaskEntry(spec, -1)
             self.tasks[spec.task_id] = entry
             self.task_queue.append(entry)
@@ -703,12 +716,17 @@ class HeadServer:
             self._unpin_args(spec)
             await self._seal_error_objects(spec, f"RayActorError: {reason}")
         actor.pending_calls.clear()
-        # drop queued creation / calls in the scheduler queue
+        # drop queued creation / calls in the scheduler queue (balancing
+        # their submit-time arg pins)
+        dropped = [e for e in self.task_queue if e.spec.actor_id == actor.actor_id]
         self.task_queue = [
             e
             for e in self.task_queue
             if not (e.spec.actor_id == actor.actor_id)
         ]
+        for e in dropped:
+            self.tasks.pop(e.spec.task_id, None)
+            self._unpin_args(e.spec)
         if actor.worker_id:
             w = self.workers.get(actor.worker_id)
             if w is not None:
@@ -760,9 +778,34 @@ class HeadServer:
         nid = p.get("node_id")
         if nid is None:
             nid = self._conn_node.get(cid) or self.head_node_id
+        self._pin_contained(bytes(p["object_id"]), p.get("contained") or [])
         self._add_location(p["object_id"], nid)
         await self._seal_object(p["object_id"])
         return {"ok": True}
+
+    def _pin_contained(self, oid: bytes, contained: List[bytes]):
+        """Pin the refs pickled inside a stored object for the container's
+        lifetime (released in _dec_ref/free when the container is deleted).
+        A re-seal with the same ids (eviction refetch) is a no-op; a re-seal
+        with different ids (reconstruction re-ran the producer, whose inner
+        put ids differ) replaces the old pins with the new ones."""
+        inner = [bytes(i) for i in contained]
+        prev = self.object_contained.get(oid)
+        if prev == inner or (prev is None and not inner):
+            return
+        if inner:
+            self.object_contained[oid] = inner
+            for iid in inner:
+                self.object_refcounts[iid] = self.object_refcounts.get(iid, 0) + 1
+        else:
+            self.object_contained.pop(oid, None)
+        if prev:
+            for iid in prev:
+                self._dec_ref(iid)
+
+    def _release_contained(self, oid: bytes):
+        for iid in self.object_contained.pop(oid, ()):  # recursive via _dec_ref
+            self._dec_ref(iid)
 
     async def _ensure_object_local(
         self, oid: bytes, dest_nid: bytes, timeout: Optional[float] = None
@@ -926,6 +969,7 @@ class HeadServer:
         for oid in p["object_ids"]:
             self.objects.pop(oid, None)
             self._delete_everywhere(oid)
+            self._release_contained(bytes(oid))
         return {"ok": True}
 
     async def h_add_ref(self, cid, conn, p):
@@ -934,18 +978,22 @@ class HeadServer:
         return {"ok": True}
 
     def _pin_args(self, spec: TaskSpec):
-        """Bump refcounts of ARG_REF arguments (inverse of _unpin_args)."""
-        for arg in spec.args:
-            if arg[0] == 1:  # ARG_REF
-                aid = bytes(arg[2])
-                self.object_refcounts[aid] = self.object_refcounts.get(aid, 0) + 1
+        """Bump refcounts of ARG_REF arguments AND refs nested inside
+        inlined ARG_VALUE payloads (inverse of _unpin_args)."""
+        for aid in self._arg_ref_ids(spec):
+            self.object_refcounts[aid] = self.object_refcounts.get(aid, 0) + 1
 
     def _unpin_args(self, spec: TaskSpec):
-        """Release the submit-time pins on ARG_REF arguments (paired with
-        the bump in h_submit_task)."""
-        for arg in spec.args:
-            if arg[0] == 1:  # ARG_REF
-                self._dec_ref(bytes(arg[2]))
+        """Release the submit-time pins on ARG_REF + nested arguments
+        (paired with the bump in h_submit_task)."""
+        for aid in self._arg_ref_ids(spec):
+            self._dec_ref(aid)
+
+    @staticmethod
+    def _arg_ref_ids(spec: TaskSpec) -> List[bytes]:
+        ids = [bytes(arg[2]) for arg in spec.args if arg[0] == 1]  # ARG_REF
+        ids.extend(bytes(i) for i in (spec.nested_refs or ()))
+        return ids
 
     def _dec_ref(self, oid: bytes):
         n = self.object_refcounts.get(oid, 0) - 1
@@ -957,6 +1005,8 @@ class HeadServer:
             # nobody can ever get() it again → its lineage is dead too
             self._drop_lineage(oid)
             self._reconstructions.pop(oid, None)
+            # the deleted container no longer pins the refs inside it
+            self._release_contained(oid)
         else:
             self.object_refcounts[oid] = n
 
@@ -988,9 +1038,7 @@ class HeadServer:
         if spec is None:
             return
         self._lineage_total -= self._lineage_bytes.pop(oid, 0)
-        for arg in spec.args:
-            if arg[0] == 1:
-                self._dec_ref(bytes(arg[2]))
+        self._unpin_args(spec)
 
     def _reconstruct_object(self, oid: bytes) -> Optional[str]:
         """Queue re-execution of the producing task for a lost object.
@@ -1155,7 +1203,9 @@ class HeadServer:
                 await self._seal_error_objects(entry.spec, p["error"])
         else:
             seal_nid = w.node_id if w is not None else self._conn_node.get(cid)
+            contained = p.get("contained") or {}
             for oid in p.get("sealed", []):
+                self._pin_contained(bytes(oid), contained.get(bytes(oid)) or [])
                 self._add_location(bytes(oid), seal_nid)
                 await self._seal_object(oid)
         self._kick_scheduler()
@@ -1225,6 +1275,9 @@ class HeadServer:
             self._mark_tables_dirty()
         for oid in spec.return_object_ids():
             self._object_entry(oid)
+        # pin creation args like any submit — the creation task's
+        # h_task_done unpins (restart re-pins before re-queueing)
+        self._pin_args(spec)
         entry = TaskEntry(spec, cid)
         self.tasks[spec.task_id] = entry
         self.task_queue.append(entry)
@@ -1430,18 +1483,36 @@ class HeadServer:
         key = p["key"]
         if p.get("overwrite", True) or key not in self.kv:
             self.kv[key] = p["value"]
+            for fut in self._kv_waiters.pop(key, []):
+                if not fut.done():
+                    fut.set_result(True)
             await self._publish(f"kv:{key}", {"key": key, "value": p["value"]})
             return {"added": True}
         return {"added": False}
 
     async def h_kv_get(self, cid, conn, p):
-        if p.get("wait"):
-            deadline = time.time() + (p.get("timeout") or RayConfig.collective_rendezvous_timeout_s)
-            while p["key"] not in self.kv:
-                if time.time() > deadline:
-                    return {"found": False}
-                await asyncio.sleep(0.01)
-        v = self.kv.get(p["key"])
+        key = p["key"]
+        if p.get("wait") and key not in self.kv:
+            # waiter future set by h_kv_put — not a poll loop: N rendezvousing
+            # workers cost zero wakeups until the key lands (r2 weak #8)
+            timeout = p.get("timeout") or RayConfig.collective_rendezvous_timeout_s
+            fut = asyncio.get_running_loop().create_future()
+            waiters = self._kv_waiters.setdefault(key, [])
+            waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return {"found": False}
+            finally:
+                # h_kv_put pops the whole list on fire; on timeout we must
+                # not leak the dead future (or the key entry) forever
+                cur = self._kv_waiters.get(key)
+                if cur is not None:
+                    if fut in cur:
+                        cur.remove(fut)
+                    if not cur:
+                        self._kv_waiters.pop(key, None)
+        v = self.kv.get(key)
         return {"found": v is not None, "value": v if v is not None else b""}
 
     async def h_kv_del(self, cid, conn, p):

@@ -117,3 +117,61 @@ def test_borrowed_ref_keeps_object_alive(ray_start_regular):
     from ray_tpu._private.worker import global_worker
 
     assert global_worker.core_worker.store.contains(oid)
+
+
+def test_borrower_no_race_stress(ray_start_regular):
+    """The sender drops its handle IMMEDIATELY after shipping a ref nested
+    inside an inlined arg — no sleep, no flush grace.  The submit message
+    carries the nested id (TaskSpec.nested_refs) and the head pins it for
+    the task's lifetime, so the sender's REMOVE_REF can never zero the
+    count first (reference: reference_count.cc borrower protocol;
+    VERDICT r2 weak #4)."""
+
+    @ray_tpu.remote
+    def consume(box):
+        return float(ray_tpu.get(box["r"], timeout=30)[0])
+
+    import gc
+
+    outs = []
+    for i in range(100):
+        ref = ray_tpu.put(np.full(1000, float(i)))
+        outs.append((i, consume.remote({"r": ref})))
+        del ref  # immediately — the race window the pin must close
+    gc.collect()
+    for i, out in outs:
+        assert ray_tpu.get(out, timeout=120) == float(i)
+
+
+def test_ref_nested_in_task_return(ray_start_regular):
+    """A task returning a ref inside a container: the return object pins the
+    inner object (TASK_DONE `contained`), surviving both the worker's and
+    the driver's container-handle drops."""
+
+    @ray_tpu.remote
+    def produce():
+        inner = ray_tpu.put(np.arange(10.0))
+        return {"r": inner}
+
+    import gc
+
+    box_ref = produce.remote()
+    box = ray_tpu.get(box_ref, timeout=60)
+    del box_ref  # container's head-side entry may now be deleted
+    gc.collect()
+    time.sleep(0.5)  # let the batched REMOVE_REF for the container land
+    assert float(ray_tpu.get(box["r"], timeout=30).sum()) == 45.0
+
+
+def test_ref_nested_in_put_container(ray_start_regular):
+    """A ref pickled inside a large ray.put container: PUT_OBJECT `contained`
+    pins the inner object for the stored container's lifetime."""
+    import gc
+
+    inner = ray_tpu.put(np.full(100, 2.0))
+    outer = ray_tpu.put([inner, np.zeros(500_000)])
+    del inner
+    gc.collect()
+    time.sleep(0.5)  # batched REMOVE_REF for the original handle lands
+    lst = ray_tpu.get(outer, timeout=30)
+    assert float(ray_tpu.get(lst[0], timeout=30)[0]) == 2.0
