@@ -1,14 +1,21 @@
-"""Headline benchmark: GPT-2 124M training throughput on the real TPU.
+"""Headline benchmark: GPT-2 124M training throughput on the real TPU,
+measured THROUGH the product path: JaxTrainer → BackendExecutor → a
+TPU-claiming worker actor running the train loop (the Ray-Train-style
+GPT-2 of BASELINE.json; reference analog:
+release/air_tests/air_benchmarks/workloads/torch_benchmark.py:214-222).
 
 Prints ONE JSON line:
   {"metric": "gpt2_124m_tokens_per_sec_per_chip", "value": N,
    "unit": "tokens/s/chip", "vs_baseline": MFU/0.45, ...}
 
 vs_baseline is measured MFU against the north-star 45% MFU target from
-BASELINE.json (reference repo publishes no absolute numbers — BASELINE.md).
+BASELINE.json (the reference repo publishes no absolute numbers —
+BASELINE.md).
 
-Run with the ambient env (sole TPU claimant).  Everything else in this repo
-runs on cpu; only this script touches the chip.
+The driver pins its own jax to CPU (never claiming the tunneled chip) and
+leaves the claim env intact for the spawned TPU worker, which is the sole
+TPU claimant.  BENCH_PATH=raw runs the step loop directly in this process
+instead (no cluster) for path-overhead comparison.
 """
 
 from __future__ import annotations
@@ -27,7 +34,21 @@ _PEAK = {
 }
 
 
-def main():
+def _bench_config():
+    return {
+        "model": os.environ.get("BENCH_MODEL", "gpt2_124m"),
+        "batch": int(os.environ.get("BENCH_BATCH", "16")),
+        "steps": int(os.environ.get("BENCH_STEPS", "10")),
+        "remat": os.environ.get("BENCH_REMAT", ""),
+        "attn": os.environ.get("BENCH_ATTN", ""),
+        "scores": os.environ.get("BENCH_SCORES", "bf16"),
+        "ce_chunk": os.environ.get("BENCH_CE_CHUNK", ""),
+    }
+
+
+def _build_bundle(cfg_d):
+    """Model + jitted train step on THIS process's devices (runs inside the
+    TPU worker on the train path; in-process on the raw path)."""
     import jax
     import jax.numpy as jnp
 
@@ -35,35 +56,36 @@ def main():
     from ray_tpu.models.lm_train import make_train_step, synthetic_batch
     from ray_tpu.parallel.mesh import MeshConfig, make_mesh
 
-    devices = jax.devices()
-    platform = devices[0].platform
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    peak = _PEAK.get(gen, _PEAK["v5e"])
-    on_tpu = platform not in ("cpu",)
-
-    model_name = os.environ.get("BENCH_MODEL", "gpt2_124m")
     cfg_kw = {}
-    if os.environ.get("BENCH_REMAT"):
-        cfg_kw["remat_policy"] = os.environ["BENCH_REMAT"]
-        cfg_kw["remat"] = os.environ["BENCH_REMAT"] != "none"
-    if os.environ.get("BENCH_ATTN"):
-        cfg_kw["attention_impl"] = os.environ["BENCH_ATTN"]
-    # bf16 attention scores halve the [S,S] HBM traffic (+17% throughput
-    # measured on v5e); softmax still accumulates f32.  BENCH_SCORES=f32
-    # reverts to the conservative default.
-    if os.environ.get("BENCH_SCORES", "bf16") == "bf16":
-        import jax.numpy as _jnp
-
-        cfg_kw["attn_scores_dtype"] = _jnp.bfloat16
-    cfg = getattr(GPT2Config, model_name)(**cfg_kw)
+    if cfg_d["remat"]:
+        cfg_kw["remat_policy"] = cfg_d["remat"]
+        cfg_kw["remat"] = cfg_d["remat"] != "none"
+    if cfg_d["attn"]:
+        cfg_kw["attention_impl"] = cfg_d["attn"]
+    if cfg_d["scores"] == "bf16":
+        # bf16 attention scores halve [S,S] HBM traffic on the xla path
+        cfg_kw["attn_scores_dtype"] = jnp.bfloat16
+    if cfg_d["ce_chunk"]:
+        cfg_kw["loss_chunk"] = int(cfg_d["ce_chunk"])
+    cfg = getattr(GPT2Config, cfg_d["model"])(**cfg_kw)
     model = GPT2Model(cfg)
+    devices = jax.devices()
     mesh = make_mesh(MeshConfig(dp=1), devices[:1])
-
-    batch = int(os.environ.get("BENCH_BATCH", "16"))
-    seq = cfg.block_size
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-
     bundle = make_train_step(model, mesh, learning_rate=3e-4)
+    return cfg, bundle, devices
+
+
+def _run_steps(cfg_d):
+    """The measured loop; returns a metrics dict.  Called inside whichever
+    process owns the chip."""
+    import jax
+
+    from ray_tpu.models.lm_train import synthetic_batch
+
+    cfg, bundle, devices = _build_bundle(cfg_d)
+    batch, steps = cfg_d["batch"], cfg_d["steps"]
+    seq = cfg.block_size
+
     params, opt_state = bundle.init(jax.random.PRNGKey(0))
     tokens, targets = synthetic_batch(jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
     tokens = jax.device_put(tokens, bundle.batch_sharding)
@@ -81,20 +103,64 @@ def main():
     final_loss = float(metrics["loss"])  # forces the whole step chain
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * steps / dt
-    mfu = tokens_per_sec * cfg.flops_per_token() / peak
+    return {
+        "platform": devices[0].platform,
+        "tokens_per_sec": batch * seq * steps / dt,
+        "flops_per_token": cfg.flops_per_token(),
+        "step_ms": 1000 * dt / steps,
+        "seq": seq,
+        "loss": final_loss,
+    }
+
+
+def _train_loop(config):
+    """Runs on the TPU worker actor via JaxTrainer."""
+    from ray_tpu.air import session
+
+    session.report(_run_steps(config))
+
+
+def main():
+    cfg_d = _bench_config()
+    raw = os.environ.get("BENCH_PATH", "train") == "raw"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK.get(gen, _PEAK["v5e"])
+
+    if raw:
+        m = _run_steps(cfg_d)
+    else:
+        # the driver must never claim the tunneled chip: pin its jax to CPU
+        # (claim env stays in os.environ so the spawned TPU worker inherits it)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import ray_tpu
+        from ray_tpu.train import JaxTrainer, ScalingConfig
+
+        ray_tpu.init(num_cpus=4, num_tpus=1)
+        trainer = JaxTrainer(
+            _train_loop,
+            train_loop_config=cfg_d,
+            scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
+        )
+        m = trainer.fit().metrics
+        ray_tpu.shutdown()
+
+    on_tpu = m["platform"] not in ("cpu",)
+    mfu = m["tokens_per_sec"] * m["flops_per_token"] / peak
     result = {
         "metric": "gpt2_124m_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(m["tokens_per_sec"], 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
         "mfu": round(mfu, 4),
-        "platform": platform,
+        "platform": m["platform"],
         "tpu_gen": gen if on_tpu else "cpu-fallback",
-        "batch": batch,
-        "seq": seq,
-        "step_ms": round(1000 * dt / steps, 2),
-        "loss": round(final_loss, 4),
+        "path": "raw" if raw else "train",
+        "batch": cfg_d["batch"],
+        "seq": m["seq"],
+        "step_ms": round(m["step_ms"], 2),
+        "loss": round(m["loss"], 4),
     }
     print(json.dumps(result))
 

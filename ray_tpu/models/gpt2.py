@@ -52,7 +52,10 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     remat: bool = True
     # "full" recomputes everything; "dots" saves matmul outputs and only
-    # recomputes elementwise ops (less FLOP overhead, more HBM)
+    # recomputes elementwise ops; "lite" saves everything EXCEPT the
+    # layernorm/gelu outputs (the cheapest recomputes with the biggest
+    # buffers) — the least-recompute policy that still fits a v5e chip at
+    # batch 16 with the splash attention kernel
     remat_policy: str = "dots"
     # "auto": pallas flash kernel on TPU, xla einsum elsewhere
     attention_impl: str = "auto"
@@ -60,6 +63,17 @@ class GPT2Config:
     # traffic; softmax still accumulates f32)
     attn_scores_dtype: Any = jnp.float32
     use_ring_attention: bool = False
+    # "fused": chunked linear-head CE that never materializes [B,S,V] logits
+    # (ops/cross_entropy.py); "naive": full-logits path; "auto" picks fused
+    # unless the sequence axis is sharded (sp ring attention), whose layout
+    # the chunked scan would break
+    loss_impl: str = "auto"
+    # sequence-chunk length per fused-CE scan step; the transient logits
+    # block is [B, loss_chunk, padded_vocab] f32
+    loss_chunk: int = 128
+    # GPipe microbatches per data shard when the mesh carries a pp axis
+    # (bubble fraction (pp-1)/(M+pp-1))
+    pp_microbatches: int = 4
 
     @property
     def padded_vocab(self) -> int:
@@ -91,7 +105,10 @@ class GPT2Config:
         """CPU-testable toy (virtual-mesh tests, dryruns)."""
         kw.setdefault("vocab_size", 512)
         kw.setdefault("block_size", 64)
-        return cls(n_layer=2, n_head=2, n_embd=64, **kw)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 2)
+        kw.setdefault("n_embd", 64)
+        return cls(**kw)
 
     def num_params(self) -> int:
         V, L, E = self.padded_vocab, self.n_layer, self.n_embd
@@ -150,11 +167,38 @@ class GPT2Model:
         }
         return params
 
-    def param_pspecs(self) -> Dict[str, Any]:
+    def param_pspecs(self, mesh=None) -> Dict[str, Any]:
         """PartitionSpecs over the standard mesh axes.  tp shards the
         contraction-free dim of each matmul (megatron column/row split);
         fsdp shards the stacked layer dim (ZeRO-3-style param sharding —
-        all-gather per layer inside scan); embeddings shard vocab on tp."""
+        all-gather per layer inside scan); embeddings shard vocab on tp.
+
+        On a pp mesh the stacked layer dim is the *stage* dim: sharded over
+        pp (one contiguous slice of layers per stage, consumed by the GPipe
+        shard_map in backbone).  pp composes with dp/fsdp batch sharding;
+        pp×tp and pp×fsdp-param-sharding need megatron-style manual
+        collectives inside the stage and are rejected up front."""
+        if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
+            shape = dict(mesh.shape)
+            if shape.get("tp", 1) > 1 or shape.get("sp", 1) > 1:
+                raise NotImplementedError(
+                    "pp composes with dp/fsdp (batch sharding); pp×tp and "
+                    "pp×sp are not supported yet"
+                )
+            specs = self.param_pspecs(None)
+
+            def relayer(spec):
+                if not isinstance(spec, P):
+                    return spec
+                parts = list(spec)
+                if parts and parts[0] == "fsdp":
+                    parts[0] = "pp"  # stage dim, not ZeRO dim, under pp
+                return P(*parts)
+
+            specs["layers"] = {
+                k: relayer(v) for k, v in specs["layers"].items()
+            }
+            return specs
         return {
             "wte": P("tp", None),
             "wpe": P(None, None),
@@ -183,13 +227,18 @@ class GPT2Model:
         B, S, E = x.shape
         H, D = cfg.n_head, cfg.head_dim
 
-        def ln(h, scale, bias):
+        from jax.ad_checkpoint import checkpoint_name
+
+        def ln(h, scale, bias, name):
             h32 = h.astype(jnp.float32)
             mu = h32.mean(-1, keepdims=True)
             var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
-            return ((h32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias).astype(cd)
+            out = ((h32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias).astype(cd)
+            # named for the "lite" remat policy: recompute-from-residual
+            # instead of saving the [B,S,E] buffer
+            return checkpoint_name(out, name)
 
-        h = ln(x, layer_params["ln1_scale"].astype(jnp.float32), layer_params["ln1_bias"].astype(jnp.float32))
+        h = ln(x, layer_params["ln1_scale"].astype(jnp.float32), layer_params["ln1_bias"].astype(jnp.float32), "ln1_out")
         qkv = h @ layer_params["qkv_w"].astype(cd) + layer_params["qkv_b"].astype(cd)
         q, k_, v_ = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
@@ -218,9 +267,9 @@ class GPT2Model:
         attn = attn.reshape(B, S, E)
         x = x + (attn @ layer_params["proj_w"].astype(cd) + layer_params["proj_b"].astype(cd))
 
-        h = ln(x, layer_params["ln2_scale"].astype(jnp.float32), layer_params["ln2_bias"].astype(jnp.float32))
+        h = ln(x, layer_params["ln2_scale"].astype(jnp.float32), layer_params["ln2_bias"].astype(jnp.float32), "ln2_out")
         h = h @ layer_params["mlp_in_w"].astype(cd) + layer_params["mlp_in_b"].astype(cd)
-        h = jax.nn.gelu(h)
+        h = checkpoint_name(jax.nn.gelu(h), "gelu_out")
         x = x + (h @ layer_params["mlp_out_w"].astype(cd) + layer_params["mlp_out_b"].astype(cd))
         return x
 
@@ -235,20 +284,31 @@ class GPT2Model:
             scores_dtype=self.config.attn_scores_dtype,
         )
 
-    def apply(
+    def backbone(
         self,
         params: Dict[str, Any],
         tokens: jax.Array,
         mesh=None,
     ) -> jax.Array:
-        """tokens [B, S] int32 → logits [B, S, padded_vocab] float32."""
+        """tokens [B, S] int32 → final hidden states [B, S, E] in
+        compute_dtype (post final layernorm, pre lm-head)."""
         cfg = self.config
         cd = cfg.compute_dtype
         B, S = tokens.shape
         x = params["wte"].astype(cd)[tokens] + params["wpe"].astype(cd)[:S][None]
 
         if cfg.remat and cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            # dots + the splash kernel's named residuals: saving the ~25MB
+            # of attention output/lse per layer avoids re-running the whole
+            # fwd attention kernel inside the backward pass
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("splash_residuals"),
+            )
+        elif cfg.remat and cfg.remat_policy == "lite":
+            policy = jax.checkpoint_policies.save_anything_except_these_names(
+                "ln1_out", "ln2_out", "gelu_out"
+            )
         else:
             policy = None
 
@@ -261,17 +321,44 @@ class GPT2Model:
                 y = self._layer(x, layer_params, mesh)
             return y, None
 
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
+            # GPipe over the pp axis: each stage scans its layer slice,
+            # activations hop stage→stage by ppermute (parallel/pipeline.py)
+            from ray_tpu.parallel.pipeline import make_pipeline
+
+            def stage_fn(stage_layers, h):
+                out, _ = jax.lax.scan(scan_body, h, stage_layers)
+                return out
+
+            pipe = make_pipeline(
+                mesh,
+                stage_fn,
+                num_microbatches=cfg.pp_microbatches,
+                batch_axes=("dp", "fsdp"),
+            )
+            x = pipe(params["layers"], x)
+        else:
+            x, _ = jax.lax.scan(scan_body, x, params["layers"])
         scale = params["ln_f"]["scale"].astype(jnp.float32)
         bias = params["ln_f"]["bias"].astype(jnp.float32)
         x32 = x.astype(jnp.float32)
         mu = x32.mean(-1, keepdims=True)
         var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
         x = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
-        logits = x.astype(cd) @ params["wte"].astype(cd).T
-        # stay in bf16: the loss upcasts inside fused reductions — returning
-        # f32 here would materialize an extra [B,S,V] f32 tensor in HBM
-        return logits
+        return x.astype(cd)
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        mesh=None,
+    ) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, padded_vocab].
+
+        Stays in bf16: the naive loss upcasts inside fused reductions —
+        returning f32 here would materialize an extra [B,S,V] f32 tensor."""
+        x = self.backbone(params, tokens, mesh)
+        return x @ params["wte"].astype(self.config.compute_dtype).T
 
     def loss(
         self,
@@ -282,9 +369,24 @@ class GPT2Model:
     ) -> jax.Array:
         """Mean next-token cross entropy; padded-vocab tail masked out.
 
-        Fused form: label logit gather + logsumexp — never materializes a
-        full log-softmax tensor (saves one [B,S,V] f32 HBM round-trip)."""
+        Default ("auto"/"fused") path: chunked linear-head CE — the [B,S,V]
+        logits tensor never exists in HBM (ops/cross_entropy.py; the single
+        biggest HBM consumer of the naive form).  "naive" keeps the
+        full-logits path for layouts the chunked scan can't express
+        (sequence axis sharded by sp ring attention)."""
         cfg = self.config
+        impl = cfg.loss_impl
+        if impl == "auto":
+            sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+            impl = "naive" if sp else "fused"
+        if impl == "fused":
+            from ray_tpu.ops.cross_entropy import fused_linear_cross_entropy
+
+            x = self.backbone(params, tokens, mesh)
+            w = params["wte"].astype(cfg.compute_dtype)
+            return fused_linear_cross_entropy(
+                x, w, targets, cfg.vocab_size, cfg.loss_chunk
+            )
         logits = self.apply(params, tokens, mesh).astype(jnp.float32)
         if cfg.padded_vocab != cfg.vocab_size:
             # select (fuses into the logsumexp reduction) instead of a

@@ -123,7 +123,10 @@ class LlamaModel:
             },
         }
 
-    def param_pspecs(self) -> Dict[str, Any]:
+    def param_pspecs(self, mesh=None) -> Dict[str, Any]:
+        # mesh accepted for interface parity with GPT2Model (whose pp path
+        # re-layers the specs); llama pp integration rides the same pipeline
+        # primitive when needed
         return {
             "tok_emb": P("tp", None),
             "out_head": P(None, "tp"),

@@ -74,7 +74,7 @@ def make_train_step(
             optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay),
         )
 
-    param_specs = model.param_pspecs()
+    param_specs = model.param_pspecs(mesh)
     # drop axes the mesh doesn't carry (e.g. running a tp-annotated model on
     # a pure-dp mesh)
     present = set(mesh.axis_names)

@@ -1,0 +1,112 @@
+"""Fused linear-head cross entropy: logits never hit HBM at full size.
+
+The LM loss `CE(x @ W^T, targets)` is the single largest HBM consumer in
+GPT-2-class training: at batch 32 / seq 1024 / vocab 50304 the naive form
+materializes a 3.1 GiB bf16 logits tensor plus a 6.1 GiB f32 copy for the
+softmax — more than a third of a v5e chip's HBM, and it OOMs the 124M bench
+beyond batch 16.
+
+This op chunks the sequence axis with `lax.scan`: each step computes a
+[B, C, V] logits block on the MXU (f32 accumulation), reduces it to
+logsumexp + label-logit immediately, and discards it.  The custom VJP
+recomputes each block in the backward pass (flash-attention-style
+recompute-over-store) and accumulates dW in f32.  Peak extra HBM is one
+[B, C, V] block instead of [B, S, V].
+
+Reference analog: none — the reference's Train layer delegates the loss to
+user torch code (reference: python/ray/train/torch/train_loop_utils.py).
+This is a TPU-native win of the same species as flash attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _num_chunks(seq: int, chunk: int) -> "tuple[int, int]":
+    """(number of chunks, adjusted chunk length): the chunk length is
+    shrunk to the largest power of two ≤ `chunk` that divides `seq`."""
+    if seq % chunk != 0:
+        for c in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+            if c <= chunk and seq % c == 0:
+                chunk = c
+                break
+    return seq // chunk, chunk
+
+
+def _block_stats(x_c, w, t_c, valid_vocab: int):
+    """One [B, C, E] block → (lse [B, C] f32, label_logit [B, C] f32)."""
+    # f32 accumulation straight out of the MXU; the [B, C, V] block is
+    # consumed by the reductions below and never escapes the scan body
+    logits = jnp.einsum("bce,ve->bcv", x_c, w, preferred_element_type=jnp.float32)
+    if valid_vocab < w.shape[0]:
+        pad = jnp.arange(w.shape[0]) >= valid_vocab
+        logits = jnp.where(pad, -1e30, logits)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+    return lse, label
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(
+    x: jax.Array,  # [B, S, E] activations (bf16)
+    w: jax.Array,  # [V, E] tied embedding / head weight (bf16)
+    targets: jax.Array,  # [B, S] int32
+    valid_vocab: int,
+    chunk: int = 128,
+) -> jax.Array:
+    """Mean next-token CE over all B*S tokens, f32 scalar."""
+    loss, _ = _fwd(x, w, targets, valid_vocab, chunk)
+    return loss
+
+
+def _fwd(x, w, targets, valid_vocab, chunk):
+    B, S, E = x.shape
+    n, chunk = _num_chunks(S, chunk)
+
+    def body(total, i):
+        x_c = jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk, axis=1)
+        t_c = jax.lax.dynamic_slice_in_dim(targets, i * chunk, chunk, axis=1)
+        lse, label = _block_stats(x_c, w, t_c, valid_vocab)
+        return total + (lse - label).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), jnp.arange(n))
+    loss = total / (B * S)
+    return loss, (x, w, targets)
+
+
+def _bwd(valid_vocab, chunk, res, g):
+    x, w, targets = res
+    B, S, E = x.shape
+    V = w.shape[0]
+    n, chunk = _num_chunks(S, chunk)
+    scale = g / (B * S)
+
+    def body(dw, i):
+        x_c = jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk, axis=1)
+        t_c = jax.lax.dynamic_slice_in_dim(targets, i * chunk, chunk, axis=1)
+        logits = jnp.einsum("bce,ve->bcv", x_c, w, preferred_element_type=jnp.float32)
+        if valid_vocab < V:
+            pad = jnp.arange(V) >= valid_vocab
+            logits = jnp.where(pad, -1e30, logits)
+        probs = jax.nn.softmax(logits, axis=-1)
+        dlogits = probs - jax.nn.one_hot(t_c, V, dtype=jnp.float32)
+        # cast once for the two MXU matmuls; accumulation stays f32
+        dlogits = (dlogits * scale).astype(x.dtype)
+        dx_c = jnp.einsum("bcv,ve->bce", dlogits, w)
+        dw = dw + jnp.einsum("bcv,bce->ve", dlogits, x_c, preferred_element_type=jnp.float32)
+        return dw, dx_c
+
+    dw, dx_chunks = jax.lax.scan(body, jnp.zeros((V, E), jnp.float32), jnp.arange(n))
+    # [n, B, C, E] → [B, S, E]
+    dx = jnp.moveaxis(dx_chunks, 0, 1).reshape(B, S, E).astype(x.dtype)
+    dtargets = np.zeros(targets.shape, dtype=jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), dtargets
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

@@ -15,7 +15,8 @@ stage's [layers_per_stage, ...] slice.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+import math
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,9 +36,12 @@ def pipeline_apply(
     ops — final norm, head — run without a gather)."""
     pp = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
-    M = num_microbatches
     B = x.shape[0]
-    assert B % M == 0, f"num_microbatches {M} must divide batch {B}"
+    # microbatch count must divide the (per-data-shard) batch: fall back to
+    # the largest divisor of B ≤ requested (exactness is unaffected — GPipe
+    # computes the same full-batch gradient at any M; fewer microbatches
+    # only widens the bubble)
+    M = max(d for d in range(1, min(num_microbatches, B) + 1) if B % d == 0)
     mbs = x.reshape(M, B // M, *x.shape[1:])
 
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -76,9 +80,11 @@ def make_pipeline(
     axis_name: str = "pp",
     num_microbatches: int = 4,
     layer_axis: int = 0,
+    batch_axes: Tuple[str, ...] = (),
 ):
     """shard_map wrapper: layer-stacked params sharded over `pp`, batch
-    replicated in, final output replicated out.
+    sharded over `batch_axes` (dp/fsdp; each data shard runs its own GPipe
+    schedule on its microbatches), final output sharded the same way.
 
     Every leaf must be layer-stacked: shape[layer_axis] divisible by the
     pp size.  Mixed trees (stacked layers + replicated extras like a final
@@ -89,6 +95,9 @@ def make_pipeline(
     from ray_tpu.parallel.mesh import shard_map_compat
 
     pp_size = mesh.shape[axis_name]
+    batch_axes = tuple(
+        a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1
+    )
 
     def specs_for(tree):
         def leaf_spec(leaf):
@@ -114,8 +123,9 @@ def make_pipeline(
             axis_name=axis_name,
             num_microbatches=num_microbatches,
         )
+        x_spec = P(batch_axes or None, *([None] * (x.ndim - 1)))
         return shard_map_compat(
-            fn, mesh, in_specs=(specs_for(stage_params), P()), out_specs=P()
+            fn, mesh, in_specs=(specs_for(stage_params), x_spec), out_specs=x_spec
         )(stage_params, x)
 
     return wrapped
