@@ -15,7 +15,6 @@ stage's [layers_per_stage, ...] slice.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Any, Callable, Tuple
 
 import jax

@@ -182,3 +182,16 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
 
 def recv(src_rank: int, group_name: str = "default"):
     return _manager.get(group_name).recv(src_rank)
+
+
+def sendrecv(per_device, pairs, group_name: str = "default"):
+    """ICI point-to-point: (src, dst) pairs executed as one ppermute over
+    the group's device mesh (single-process multi-device groups; the
+    multigpu flavor of reference send/recv, collective.py:531,594)."""
+    g = _manager.get(group_name)
+    if hasattr(g, "rank"):  # dcn: cross-process groups use send()/recv()
+        raise ValueError(
+            "sendrecv() is ICI-only (one process, many devices); "
+            "for DCN groups use send()/recv()"
+        )
+    return g.sendrecv(per_device, pairs)

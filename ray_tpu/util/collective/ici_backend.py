@@ -8,14 +8,15 @@ jitted XLA ops over a 1-D device mesh — psum/all_gather/psum_scatter/
 ppermute ride the ICI fabric with zero Python in the loop.
 
 "rank" here is a *device* index within this process's group, matching the
-reference's *_multigpu variants (one process, several devices).  For
-cross-process groups use the DCN backend; for whole-pod SPMD use
-ray_tpu.parallel (mesh + pjit), which is the first-class path.
+reference's *_multigpu variants (one process, several devices).  Inputs
+are one array per device; outputs are device-resident shards placed on
+the group's devices (rank i's output lives on device i — the invariant
+the tests assert).  For cross-process groups use the DCN backend; for
+whole-pod SPMD use ray_tpu.parallel (mesh + pjit), the first-class path.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional
 
 import numpy as np
@@ -30,8 +31,28 @@ _OP_TO_JAX = {
 }
 
 
+def _psum_like(x, op_name: str, axis_name: str):
+    import jax
+
+    if op_name == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op_name == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op_name == "min":
+        return jax.lax.pmin(x, axis_name)
+    # product: log-free generic form via all_gather + reduce (rare op)
+    gathered = jax.lax.all_gather(x, axis_name)
+    return gathered.prod(axis=0)
+
+
 class IciGroup:
-    """A collective group over this process's local jax devices."""
+    """A collective group over this process's local jax devices.
+
+    Every collective is an XLA program over the group mesh (shard_map over
+    the 1-D ``ici`` axis): data stays device-resident, cross-device traffic
+    is compiler-scheduled ICI collectives — never host round-trips
+    (reference API parity: collective.py:423 allgather, :472 reducescatter,
+    :531 send / :594 recv → ppermute)."""
 
     def __init__(self, group_name: str, devices: Optional[list] = None):
         import jax
@@ -40,75 +61,161 @@ class IciGroup:
         self.devices = devices if devices is not None else list(jax.devices())
         self.world_size = len(self.devices)
         self._mesh = None
+        # per-instance compiled-op cache — destroy() drops it (an lru_cache
+        # on the bound method would pin dead groups + executables globally)
+        self._op_cache: dict = {}
 
     @property
     def mesh(self):
         if self._mesh is None:
-            import jax
             from jax.sharding import Mesh
 
             self._mesh = Mesh(np.array(self.devices), axis_names=("ici",))
         return self._mesh
 
-    @functools.lru_cache(maxsize=32)
-    def _allreduce_fn(self, op_name: str):
+    # ------------------------------------------------------------ plumbing
+
+    def _stack_sharded(self, per_device: List):
+        """One array per device → a [W, ...] jax.Array whose i-th slice
+        lives on device i (zero host copies for device-resident inputs)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        assert len(per_device) == self.world_size, (
+            f"group {self.group_name}: expected {self.world_size} inputs, "
+            f"got {len(per_device)}"
+        )
+        shards = [
+            jax.device_put(jnp.asarray(x)[None], d)
+            for x, d in zip(per_device, self.devices)
+        ]
+        shape = (self.world_size, *shards[0].shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(self.mesh, P("ici")), shards
+        )
+
+    def _unstack(self, result) -> List:
+        """[W, ...] array sharded over ici → per-device list (device i's
+        slice stays on device i)."""
+        out = [None] * self.world_size
+        dev_index = {d: i for i, d in enumerate(self.devices)}
+        for shard in result.addressable_shards:
+            i = dev_index[shard.device]
+            out[i] = shard.data[0]
+        return out
+
+    def _sharded_op(self, kind: str, op_name: str = "sum", perm: tuple = ()):
+        """Jitted shard_map collective over the group mesh (cached per
+        (kind, op, perm) on this instance)."""
+        key = (kind, op_name, perm)
+        cached = self._op_cache.get(key)
+        if cached is not None:
+            return cached
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ray_tpu.parallel.mesh import shard_map_compat
+
         mesh = self.mesh
+        sharded = NamedSharding(mesh, P("ici"))
 
-        @functools.partial(
-            jax.jit,
-            in_shardings=NamedSharding(mesh, P("ici")),
-            out_shardings=NamedSharding(mesh, P()),
-        )
-        def _reduce(stacked):
-            if op_name == "sum":
-                return stacked.sum(axis=0)
-            if op_name == "prod":
-                return stacked.prod(axis=0)
-            if op_name == "min":
-                return stacked.min(axis=0)
-            return stacked.max(axis=0)
+        if kind == "allreduce":
 
-        return _reduce
+            def body(x):  # x: [1, ...] local slice
+                return _psum_like(x[0], op_name, "ici")[None]
+
+            in_specs, out_specs = P("ici"), P("ici")
+        elif kind == "allgather":
+
+            def body(x):
+                import jax.numpy as jnp
+
+                g = jax.lax.all_gather(x[0], "ici")  # [W, ...] on every rank
+                return g[None]  # local [1, W, ...]
+
+            in_specs, out_specs = P("ici"), P("ici")
+        elif kind == "reducescatter":
+
+            def body(x):
+                # x[0]: this rank's full input [W*chunk]; psum_scatter
+                # leaves rank i with the i-th chunk of the sum
+                return jax.lax.psum_scatter(x[0], "ici", tiled=True)[None]
+
+            in_specs, out_specs = P("ici"), P("ici")
+        elif kind == "permute":
+
+            def body(x):
+                return jax.lax.ppermute(x[0], "ici", list(perm))[None]
+
+            in_specs, out_specs = P("ici"), P("ici")
+        elif kind == "broadcast":
+            src = perm[0]
+
+            def body(x):
+                # ppermute sources must be unique, so broadcast rides the
+                # all-gather tree and each rank keeps the src slice
+                g = jax.lax.all_gather(x[0], "ici")
+                return g[src][None]
+
+            in_specs, out_specs = P("ici"), P("ici")
+        else:
+            raise ValueError(kind)
+
+        fn = shard_map_compat(body, mesh, in_specs=(in_specs,), out_specs=out_specs)
+        compiled = jax.jit(fn, out_shardings=sharded)
+        self._op_cache[key] = compiled
+        return compiled
+
+    # ---------------------------------------------------------- collectives
 
     def allreduce(self, per_device: List, op: ReduceOp = ReduceOp.SUM):
-        """Input: one array per device (the multigpu calling convention).
-        Output: the reduced array, replicated."""
-        import jax
-        import jax.numpy as jnp
-
-        stacked = jnp.stack([jnp.asarray(x) for x in per_device])
-        # shard the stacked leading axis across the group's devices so the
-        # reduction's cross-device traffic is an XLA all-reduce over ICI
-        result = self._allreduce_fn(_OP_TO_JAX[op])(stacked)
-        return [result] * self.world_size
+        """Output: rank i's reduced copy lives on device i."""
+        stacked = self._stack_sharded(per_device)
+        return self._unstack(self._sharded_op("allreduce", _OP_TO_JAX[op])(stacked))
 
     def broadcast(self, per_device: List, src_rank: int = 0):
-        import jax
-
-        src = per_device[src_rank]
-        return [jax.device_put(src, d) for d in self.devices]
+        stacked = self._stack_sharded(per_device)
+        return self._unstack(self._sharded_op("broadcast", perm=(src_rank,))(stacked))
 
     def allgather(self, per_device: List):
-        import jax.numpy as jnp
-
-        gathered = [jnp.asarray(x) for x in per_device]
-        return [list(gathered) for _ in range(self.world_size)]
+        """Output: rank i holds [W, ...] (all ranks' inputs) on device i."""
+        stacked = self._stack_sharded(per_device)
+        return self._unstack(self._sharded_op("allgather")(stacked))
 
     def reducescatter(self, per_device: List, op: ReduceOp = ReduceOp.SUM):
+        """Each rank contributes a full-size tensor; rank i receives the
+        i-th 1-D chunk of the elementwise reduction, on device i
+        (reference semantics: collective.py:472).  Inputs of any shape are
+        flattened; SUM with world-size-divisible length rides XLA
+        psum_scatter, everything else reduces then slices."""
         import jax.numpy as jnp
 
-        reduced = self.allreduce(per_device, op)[0]
-        flat = reduced.reshape(-1)
-        splits = jnp.array_split(flat, self.world_size)
-        return [splits[i] for i in range(self.world_size)]
+        op_name = _OP_TO_JAX[op]
+        flat_in = [jnp.asarray(x).reshape(-1) for x in per_device]
+        n = int(flat_in[0].size)
+        if op_name == "sum" and n % self.world_size == 0:
+            stacked = self._stack_sharded(flat_in)
+            return self._unstack(self._sharded_op("reducescatter")(stacked))
+        # non-sum ops / uneven lengths: allreduce then per-rank slice
+        reduced = self.allreduce(flat_in, op)
+        W = self.world_size
+        return [jnp.array_split(r, W)[i] for i, r in enumerate(reduced)]
 
     def reduce(self, per_device: List, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
         reduced = self.allreduce(per_device, op)
         # only dst holds the result; others keep their input (ref semantics)
-        return [reduced[i] if i == dst_rank else per_device[i] for i in range(self.world_size)]
+        return [
+            reduced[i] if i == dst_rank else per_device[i]
+            for i in range(self.world_size)
+        ]
+
+    def sendrecv(self, per_device: List, pairs: List[tuple]):
+        """Point-to-point via ppermute: each (src, dst) pair moves src's
+        array onto dst's device; ranks not receiving get zeros (ppermute
+        semantics — reference send/recv, collective.py:531,594)."""
+        stacked = self._stack_sharded(per_device)
+        return self._unstack(self._sharded_op("permute", perm=tuple(pairs))(stacked))
 
     def barrier(self):
         import jax
@@ -117,3 +224,4 @@ class IciGroup:
 
     def destroy(self):
         self._mesh = None
+        self._op_cache.clear()

@@ -187,6 +187,11 @@ def test_dcn_ring_rejects_unverified_connection():
         np.testing.assert_allclose(results[r], 3.0)
 
 
+def _devices_of(arr):
+    devs = getattr(arr, "devices", None)
+    return set(devs()) if callable(devs) else {arr.device}
+
+
 def test_ici_group_allreduce_virtual_devices():
     """ICI backend over the 8 virtual CPU devices (conftest forces them)."""
     import jax
@@ -200,6 +205,92 @@ def test_ici_group_allreduce_virtual_devices():
     per_device = [np.full((4, 4), float(i)) for i in range(8)]
     out = g.allreduce(per_device, ReduceOp.SUM)
     np.testing.assert_allclose(np.asarray(out[0]), np.full((4, 4), sum(range(8))))
+    # rank i's copy must be DEVICE-RESIDENT on device i (an XLA collective,
+    # not a host-side reduction)
+    for i in range(8):
+        assert _devices_of(out[i]) == {devices[i]}, f"rank {i} output off-device"
     out = g.allreduce(per_device, ReduceOp.MAX)
     np.testing.assert_allclose(np.asarray(out[0]), np.full((4, 4), 7.0))
+    g.destroy()
+
+
+def test_ici_allgather_device_resident():
+    """allgather: every rank ends with the full [W, ...] stack ON ITS OWN
+    device — fails a host-list emulation (reference: collective.py:423)."""
+    import jax
+
+    from ray_tpu.util.collective.ici_backend import IciGroup
+
+    devices = jax.devices()
+    g = IciGroup("ici_ag", devices)
+    per_device = [np.full((3,), float(i + 1)) for i in range(8)]
+    out = g.allgather(per_device)
+    expect = np.stack([np.full((3,), float(i + 1)) for i in range(8)])
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[i]), expect)
+        assert _devices_of(out[i]) == {devices[i]}, f"rank {i} gather off-device"
+    g.destroy()
+
+
+def test_ici_reducescatter_device_resident():
+    """reducescatter: rank i gets the i-th chunk of the sum, on device i
+    (reference: collective.py:472)."""
+    import jax
+
+    from ray_tpu.util.collective.ici_backend import IciGroup
+    from ray_tpu.util.collective.types import ReduceOp
+
+    devices = jax.devices()
+    g = IciGroup("ici_rs", devices)
+    # each rank contributes a distinct full-length vector of 8 chunks × 2
+    per_device = [np.arange(16, dtype=np.float32) + 100 * i for i in range(8)]
+    out = g.reducescatter(per_device, ReduceOp.SUM)
+    total = np.sum([np.arange(16, dtype=np.float32) + 100 * i for i in range(8)], axis=0)
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[i]), total[2 * i : 2 * i + 2])
+        assert _devices_of(out[i]) == {devices[i]}, f"rank {i} scatter off-device"
+    # multi-dim inputs flatten to consistent 1-D chunks for every op
+    md = [np.ones((4, 4), np.float32) * (i + 1) for i in range(8)]
+    out_sum = g.reducescatter(md, ReduceOp.SUM)
+    out_max = g.reducescatter(md, ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(out_sum[0]), np.full(2, 36.0))
+    np.testing.assert_allclose(np.asarray(out_max[0]), np.full(2, 8.0))
+    g.destroy()
+
+
+def test_ici_sendrecv_ppermute():
+    """send/recv (reference: collective.py:531,594) via ppermute: a ring
+    shift moves rank i's tensor onto rank (i+1)'s device."""
+    import jax
+
+    from ray_tpu.util.collective.ici_backend import IciGroup
+
+    devices = jax.devices()
+    g = IciGroup("ici_pp", devices)
+    per_device = [np.full((2, 2), float(i)) for i in range(8)]
+    ring = [(i, (i + 1) % 8) for i in range(8)]
+    out = g.sendrecv(per_device, ring)
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[i]), np.full((2, 2), float((i - 1) % 8)))
+        assert _devices_of(out[i]) == {devices[i]}, f"rank {i} recv off-device"
+    # single pair: only the destination receives; others get zeros
+    named = [np.full((2, 2), float(i + 10)) for i in range(8)]
+    out = g.sendrecv(named, [(0, 3)])
+    np.testing.assert_allclose(np.asarray(out[3]), np.full((2, 2), 10.0))
+    np.testing.assert_allclose(np.asarray(out[1]), np.zeros((2, 2)))
+    g.destroy()
+
+
+def test_ici_broadcast_device_resident():
+    import jax
+
+    from ray_tpu.util.collective.ici_backend import IciGroup
+
+    devices = jax.devices()
+    g = IciGroup("ici_bc", devices)
+    per_device = [np.full((4,), float(i)) for i in range(8)]
+    out = g.broadcast(per_device, src_rank=2)
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[i]), np.full((4,), 2.0))
+        assert _devices_of(out[i]) == {devices[i]}, f"rank {i} bcast off-device"
     g.destroy()
