@@ -33,6 +33,8 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "idle_worker_kill_s": (float, 300.0, "kill idle workers after this long"),
     # -- objects --
     "max_direct_call_object_size": (int, 100 * 1024, "objects <= this inline in the owner store"),
+    "enable_direct_actor_calls": (bool, True, "callers push actor tasks straight to the actor's worker (head only for FSM/fallback)"),
+    "direct_call_reorder_wait_s": (float, 2.0, "max wait for an out-of-order direct actor call's predecessors"),
     "object_store_memory": (int, 512 * 1024 * 1024, "default shm store capacity (bytes)"),
     "object_transfer_chunk_bytes": (int, 5 * 1024 * 1024, "chunk size for node-to-node object push"),
     "fetch_warn_timeout_s": (float, 30.0, "warn if an object fetch stalls this long"),

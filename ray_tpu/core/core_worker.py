@@ -129,6 +129,25 @@ class CoreWorker:
         self._exported_functions: Dict[bytes, bool] = {}
         self._fetched_functions: Dict[bytes, Any] = {}
         self._actor_seq: Dict[bytes, int] = {}
+        # --- direct actor-call state (reference analog: DirectActorSubmitter
+        # + the in-process memory store, core_worker.cc:1146) ---
+        # small direct-call results live here, never in shm or at the head
+        self._memory_store: Dict[bytes, SerializedObject] = {}
+        # oid -> threading.Event set when its direct reply lands
+        self._direct_pending: Dict[bytes, threading.Event] = {}
+        # signalled on every direct completion (wait() blocks here instead
+        # of on individual events, which would starve in list order)
+        self._direct_cv = threading.Condition()
+        self._direct_conns: Dict[bytes, Connection] = {}  # actor_id -> conn
+        # task_id -> arg ObjectRef handles held until the reply: the head
+        # never sees a direct task, so the CALLER's local refs are what pin
+        # the args for the call's duration
+        self._direct_keepalive: Dict[bytes, list] = {}
+        # last failed ALIVE probe per actor (negative cache: don't pay an
+        # ACTOR_STATE round-trip per submit while the actor is creating;
+        # invalidated by the head's actor-state pubsub on ALIVE)
+        self._direct_probe_at: Dict[bytes, float] = {}
+        self._actor_events_subscribed = False
         self._push_task_handler: Optional[Callable[[dict], None]] = None
         self._early_pushes: List[dict] = []  # frames that raced handler setup
         self._subscriptions: Dict[str, Callable[[dict], None]] = {}
@@ -224,6 +243,9 @@ class CoreWorker:
             if n <= 0:
                 self._local_refs.pop(oid, None)
                 self._pending_removals.append(oid)
+                # direct-call results live only in this process: last local
+                # ref gone = value unreachable
+                self._memory_store.pop(oid, None)
             else:
                 self._local_refs[oid] = n
 
@@ -274,6 +296,9 @@ class CoreWorker:
         return ObjectRef(oid, self)
 
     def put_object(self, oid: bytes, sobj: SerializedObject):
+        # refs to memory-store-only values (direct-call results) must be
+        # globally resolvable once they leave this process
+        self._promote_memory_objects(sobj.contained)
         if not self.store.put_serialized(oid, sobj):
             pass  # already present (idempotent put)
         # contained refs ride the seal message so the head pins the inner
@@ -283,13 +308,42 @@ class CoreWorker:
             {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
         )
 
+    def _promote_memory_objects(self, oids: Sequence[bytes]):
+        """Make memory-store-only values (inline direct-call results)
+        globally resolvable before their refs ship to another process:
+        write to the node store + seal at the head (recursing through
+        refs contained in the promoted values themselves)."""
+        for oid in oids:
+            oid = bytes(oid)
+            sobj = self._memory_store.get(oid)
+            if sobj is None or self.store is None or self.store.contains(oid):
+                continue
+            self._promote_memory_objects(sobj.contained)
+            self.store.put_serialized(oid, sobj)
+            self.request(
+                MsgType.PUT_OBJECT,
+                {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
+            )
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = time.monotonic() + timeout if timeout is not None else None
         out: List[Any] = [None] * len(refs)
         pending: List[Tuple[int, bytes]] = []
         for i, ref in enumerate(refs):
             oid = ref.binary() if isinstance(ref, ObjectRef) else bytes(ref)
-            sobj = self.store.get_serialized(oid)
+            if oid in self._direct_pending:
+                # in-flight direct actor call: wait for its reply, then
+                # resolve from whatever it produced (memory store / shm /
+                # head fallback).  Release our CPU while blocked, like the
+                # head-wait path below.
+                self._notify_blocked(True)
+                try:
+                    self._resolve_direct(oid, deadline)
+                finally:
+                    self._notify_blocked(False)
+            sobj = self._memory_store.get(oid)
+            if sobj is None and self.store is not None:
+                sobj = self.store.get_serialized(oid)
             if sobj is not None:
                 out[i] = self._materialize(sobj)
             else:
@@ -385,11 +439,39 @@ class CoreWorker:
         of client polling — the head wakes us on seal."""
         ready_idx = set()
         pending_ids = []
+        direct_ids = []
         for i, ref in enumerate(refs):
-            if self.store.contains(ref.binary()):
+            oid = ref.binary()
+            if oid in self._memory_store or self.store.contains(oid):
                 ready_idx.add(i)
+            elif oid in self._direct_pending:
+                direct_ids.append((i, oid))
             else:
                 pending_ids.append((i, ref.binary()))
+        if len(ready_idx) < num_returns and direct_ids:
+            # in-flight direct calls: block on the shared completion
+            # condition and recheck ALL of them each wake (per-event waits
+            # in list order would let a slow early call starve detection of
+            # an already-finished later one)
+            deadline = time.monotonic() + timeout if timeout is not None else None
+            with self._direct_cv:
+                while True:
+                    still = []
+                    for i, oid in direct_ids:
+                        if oid not in self._direct_pending:
+                            if oid in self._memory_store or self.store.contains(oid):
+                                ready_idx.add(i)
+                            else:
+                                pending_ids.append((i, oid))
+                        else:
+                            still.append((i, oid))
+                    direct_ids = still
+                    if not direct_ids or len(ready_idx) >= num_returns:
+                        break
+                    rem = None if deadline is None else deadline - time.monotonic()
+                    if rem is not None and rem <= 0:
+                        break
+                    self._direct_cv.wait(rem)
         if len(ready_idx) < num_returns and pending_ids:
             reply = self.request(
                 MsgType.WAIT_OBJECT,
@@ -410,6 +492,8 @@ class CoreWorker:
         return ready, not_ready
 
     def free(self, refs: Sequence[ObjectRef]):
+        for r in refs:
+            self._memory_store.pop(r.binary(), None)
         self.request(MsgType.FREE_OBJECT, {"object_ids": [r.binary() for r in refs]})
 
     # ----------------------------------------------------------------- tasks
@@ -524,8 +608,141 @@ class CoreWorker:
             seq_no=seq,
             caller_id=self.worker_id.binary(),
         )
+        conn = self._direct_conn(actor_id)
+        if conn is not None:
+            for oid in spec.return_object_ids():
+                self._direct_pending[oid] = threading.Event()
+            # the head never sees this task, so no head-side arg pin exists:
+            # hold local handles on every referenced arg until the reply so
+            # our own batched REMOVE_REF can't zero them mid-call
+            arg_ids = [bytes(a[2]) for a in spec.args if a[0] == ARG_REF]
+            arg_ids += [bytes(i) for i in nested_refs]
+            self._direct_keepalive[spec.task_id] = [
+                ObjectRef(oid, self) for oid in arg_ids
+            ]
+            self.io.spawn(self._direct_call(conn, spec, actor_id))
+            return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
         self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
+
+    # -------------------------------------------------- direct actor calls
+
+    def _direct_conn(self, actor_id: bytes) -> Optional[Connection]:
+        """Open (or reuse) a connection straight to the actor's worker —
+        the head stays out of the per-call loop (reference analog:
+        direct_actor_task_submitter.cc).  Returns None when the actor
+        isn't ALIVE yet or direct calls are disabled: those calls take
+        the head path, which queues through the actor FSM."""
+        if not RayConfig.enable_direct_actor_calls:
+            return None
+        conn = self._direct_conns.get(actor_id)
+        if conn is not None and not conn.closed:
+            return conn
+        self._direct_conns.pop(actor_id, None)
+        last = self._direct_probe_at.get(actor_id)
+        if last is not None and time.monotonic() - last < 5.0:
+            return None  # known not-ALIVE: skip the probe, head path
+        try:
+            reply = self.request(MsgType.ACTOR_STATE, {"actor_id": actor_id})
+        except Exception:
+            return None
+        addr = reply.get("direct_addr") or ""
+        if reply.get("state") != "ALIVE" or not addr:
+            # negative-cache until the head's actor pubsub reports ALIVE
+            self._direct_probe_at[actor_id] = time.monotonic()
+            self._subscribe_actor_events()
+            return None
+        self._direct_probe_at.pop(actor_id, None)
+        host, port_s = addr.rsplit(":", 1)
+        try:
+            conn = self.io.call(
+                Connection.connect(host, int(port_s), RayConfig.connect_timeout_s)
+            )
+        except Exception:
+            return None
+        self._direct_conns[actor_id] = conn
+        self.io.spawn(self._direct_read_loop(conn))
+        return conn
+
+    def _subscribe_actor_events(self):
+        """Clear the not-ALIVE cache the moment the head reports an actor
+        ALIVE, so the very next call probes and goes direct."""
+        if self._actor_events_subscribed:
+            return
+        self._actor_events_subscribed = True
+
+        def _on_actor_event(msg: dict):
+            if msg.get("state") == "ALIVE":
+                self._direct_probe_at.pop(bytes(msg.get("actor_id", b"")), None)
+
+        try:
+            self.subscribe("actor", _on_actor_event)
+        except Exception:
+            self._actor_events_subscribed = False
+
+    async def _direct_read_loop(self, conn: Connection):
+        try:
+            while True:
+                msg_type, rid, payload = await conn.read_frame()
+                conn.dispatch_reply(msg_type, rid, payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            conn.close()
+
+    async def _direct_call(self, conn: Connection, spec: TaskSpec, actor_id: bytes):
+        try:
+            reply = await conn.request(
+                MsgType.ACTOR_CALL, {"spec": spec.to_wire()}, timeout=None
+            )
+        except Exception:
+            # conn died mid-call (actor crash/restart/migration): in-flight
+            # actor calls fail — NEVER resubmit, the method may have side
+            # effects and already run (reference semantics: actor death
+            # fails in-flight calls with RayActorError; retrying a crash()
+            # would kill the restarted actor again).  Subsequent calls
+            # re-resolve through the head, which owns the FSM.
+            self._direct_conns.pop(actor_id, None)
+            from ray_tpu.exceptions import RayTaskError
+
+            err = serialization.serialize(
+                RayTaskError(
+                    spec.method_name,
+                    f"worker died while running {spec.method_name}: "
+                    "direct connection lost",
+                    cause=WorkerCrashedError(
+                        f"worker died while running {spec.method_name}"
+                    ),
+                )
+            )
+            for oid in spec.return_object_ids():
+                self._memory_store[oid] = err
+            self._wake_direct(spec)
+            return
+        inline = reply.get("inline") or {}
+        for oid, wire in inline.items():
+            self._memory_store[bytes(oid)] = SerializedObject.from_wire(wire)
+        self._wake_direct(spec)
+
+    def _wake_direct(self, spec: TaskSpec):
+        # (absent memory-store entries mean a stored result: get() falls
+        # through to the normal store/head resolution)
+        self._direct_keepalive.pop(spec.task_id, None)
+        for oid in spec.return_object_ids():
+            ev = self._direct_pending.pop(oid, None)
+            if ev is not None:
+                ev.set()
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+
+    def _resolve_direct(self, oid: bytes, deadline: Optional[float]) -> bool:
+        """Block until an in-flight direct call for oid completes.  True if
+        the caller should re-check local sources (always, on completion)."""
+        ev = self._direct_pending.get(oid)
+        if ev is None:
+            return True
+        rem = None if deadline is None else max(0.0, deadline - time.monotonic())
+        if not ev.wait(rem):
+            raise GetTimeoutError(f"get() timed out on direct call {oid.hex()[:16]}")
+        return True
 
     def _encode_args(self, args: tuple, kwargs: dict) -> Tuple[List[list], List[bytes]]:
         """Inline small values; put large ones in the store and pass refs
@@ -540,10 +757,12 @@ class CoreWorker:
         items = [(False, a) for a in args] + [(k, v) for k, v in kwargs.items()]
         for key, value in items:
             if isinstance(value, ObjectRef):
+                self._promote_memory_objects([value.binary()])
                 encoded.append([ARG_REF, key if key else None, value.binary()])
                 continue
             sobj = serialization.serialize(value)
             if sobj.total_bytes() <= limit:
+                self._promote_memory_objects(sobj.contained)
                 encoded.append([ARG_VALUE, key if key else None, sobj.to_wire()])
                 nested.extend(sobj.contained)
             else:
@@ -680,6 +899,12 @@ class CoreWorker:
 
     def disconnect(self):
         self.connected = False
+        for c in list(self._direct_conns.values()):
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._direct_conns.clear()
         try:
             self.conn.close()
         except Exception:

@@ -45,6 +45,12 @@ class WorkerRuntime:
         self.task_queue: "queue.Queue[dict]" = queue.Queue()
         self.cancelled: set = set()
         self._concurrency_sem: Optional[threading.Semaphore] = None
+        self._direct_server = None
+        # per-caller sequential ordering across the head→direct transition
+        # (reference analog: sequential_actor_submit_queue.cc): seq we expect
+        # next per caller_id, plus held-back out-of-order specs
+        self._expected_seq: Dict[bytes, int] = {}
+        self._held: Dict[bytes, Dict[int, dict]] = {}
 
     # ------------------------------------------------------------ main loop
 
@@ -58,12 +64,73 @@ class WorkerRuntime:
             if "cancel" in payload:
                 self.cancelled.add(payload["cancel"])
                 continue
+            if "flush_held" in payload:
+                for s, r in self._flush_expired(payload["flush_held"]):
+                    self._execute_guarded(s, r)
+                continue
             spec = TaskSpec.from_wire(payload["spec"])
+            reply_to = payload.get("direct")
+            if spec.task_type == ACTOR_TASK and self._concurrency_sem is None:
+                # sequential actor: enforce per-caller seq order so calls
+                # that raced the head→direct routing transition still run
+                # in submission order
+                for s, r in self._sequence(spec, reply_to):
+                    self._execute_guarded(s, r)
+                continue
             if spec.task_type == ACTOR_TASK and self._concurrency_sem is not None:
                 # concurrent actor: run in the pool, keep pulling
-                self.actor.executor.submit(self._execute_guarded, spec)
+                self.actor.executor.submit(self._execute_guarded, spec, reply_to)
             else:
-                self._execute_guarded(spec)
+                self._execute_guarded(spec, reply_to)
+
+    def _sequence(self, spec: TaskSpec, reply_to):
+        """Yield (spec, reply) pairs now runnable under per-caller seq
+        order; hold out-of-order arrivals (bounded wait, then run anyway —
+        at-least-once retry semantics make duplicates possible)."""
+        import time as _time
+
+        from ray_tpu._private.config import RayConfig
+
+        caller = spec.caller_id or b""
+        if caller not in self._expected_seq and spec.seq_no == 0:
+            self._expected_seq[caller] = 0  # genuine first call
+        if caller not in self._expected_seq or spec.seq_no > self._expected_seq[caller]:
+            # Out of order, or first contact at seq>0 — the caller's earlier
+            # calls may still be in flight on the head path (a direct frame
+            # can win that race), or we're a restarted worker mid-stream.
+            # Hold; the flush timer runs it anyway if no predecessor shows
+            # (a gap may never fill, e.g. predecessor died with the old
+            # worker).
+            held = self._held.setdefault(caller, {})
+            held[spec.seq_no] = {"reply": reply_to, "spec": spec, "t": _time.time()}
+            limit = RayConfig.direct_call_reorder_wait_s
+            threading.Timer(
+                limit + 0.05, lambda: self.task_queue.put({"flush_held": caller})
+            ).start()
+            return
+        self._expected_seq[caller] = max(self._expected_seq[caller], spec.seq_no + 1)
+        yield spec, reply_to
+        held = self._held.get(caller, {})
+        while self._expected_seq[caller] in held:
+            nxt = held.pop(self._expected_seq[caller])
+            self._expected_seq[caller] += 1
+            yield nxt["spec"], nxt["reply"]
+
+    def _flush_expired(self, caller: bytes):
+        """Run held-back out-of-order calls whose wait expired (in seq
+        order), advancing expected past them."""
+        import time as _time
+
+        from ray_tpu._private.config import RayConfig
+
+        held = self._held.get(caller, {})
+        limit = RayConfig.direct_call_reorder_wait_s
+        now = _time.time()
+        for s in sorted(held):
+            if now - held[s]["t"] >= limit or s <= self._expected_seq.get(caller, 0):
+                h = held.pop(s)
+                self._expected_seq[caller] = max(self._expected_seq.get(caller, 0), s + 1)
+                yield h["spec"], h["reply"]
 
     def on_push(self, payload: dict):
         """Called from the io thread; never block it."""
@@ -73,14 +140,18 @@ class WorkerRuntime:
 
     # ------------------------------------------------------------ execution
 
-    def _execute_guarded(self, spec: TaskSpec):
+    def _execute_guarded(self, spec: TaskSpec, reply_to=None):
         import time as _time
+
+        from ray_tpu._private.config import RayConfig
 
         sealed: List[bytes] = []
         contained: Dict[bytes, List[bytes]] = {}
+        inline: Dict[bytes, list] = {}  # oid -> SerializedObject wire (direct replies)
         error: Optional[str] = None
         stored_error = False
         exec_start = _time.time()
+        direct = reply_to is not None
         try:
             if spec.task_id in self.cancelled:
                 raise RayTaskError(
@@ -89,8 +160,21 @@ class WorkerRuntime:
                 )
             results = self._execute(spec)
             outs = self._normalize_returns(spec, results)
+            limit = RayConfig.max_direct_call_object_size
             for oid, value in outs:
                 sobj = serialization.serialize(value)
+                # direct small refless results reply inline and never touch
+                # the store or the head (the reference's in-process memory
+                # store for direct-call returns, core_worker.cc:1146);
+                # results CONTAINING refs go through the store so the
+                # head's containment pinning covers them
+                if direct and sobj.total_bytes() <= limit and not sobj.contained:
+                    inline[oid] = sobj.to_wire()
+                    continue
+                # refs to OUR memory-store-only values (results of direct
+                # calls we made) must be globally resolvable before they
+                # ship inside this return
+                self.cw._promote_memory_objects(sobj.contained)
                 self.cw.store.put_serialized(oid, sobj)
                 sealed.append(oid)
                 if sobj.contained:
@@ -106,6 +190,9 @@ class WorkerRuntime:
             try:
                 for oid in spec.return_object_ids():
                     sobj = serialization.serialize(err)
+                    if direct and not sobj.contained:
+                        inline[oid] = sobj.to_wire()
+                        continue
                     self.cw.store.put_serialized(oid, sobj)
                     sealed.append(oid)
                     if sobj.contained:
@@ -118,6 +205,28 @@ class WorkerRuntime:
             traceback.print_exc(file=sys.stderr)
         finally:
             self.cw.current_task_id = None
+        if direct:
+            # over-limit / ref-containing results were stored: seal them at
+            # the head first, then answer the caller (inline errors raise
+            # client-side on deserialize, like stored ones)
+            try:
+                if sealed:
+                    self.cw.task_done(
+                        spec.task_id,
+                        sealed,
+                        None,
+                        True,
+                        exec_start=exec_start,
+                        exec_end=_time.time(),
+                        contained=contained,
+                    )
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+            conn, rid = reply_to
+            self.cw.io.spawn(
+                conn.reply(rid, {"inline": inline, "stored": sealed})
+            )
+            return
         try:
             self.cw.task_done(
                 spec.task_id,
@@ -171,6 +280,7 @@ class WorkerRuntime:
                 self.actor.executor = ThreadPoolExecutor(max_workers=concurrency)
                 self._concurrency_sem = threading.Semaphore(concurrency)
             self.actor.instance = cls(*args, **kwargs)
+            self._start_direct_server(spec.actor_id)
             return None
         if spec.task_type == ACTOR_TASK:
             inst = self.actor.instance
@@ -215,6 +325,46 @@ class WorkerRuntime:
         self.actor.async_loop = loop
         t = threading.Thread(target=loop.run_forever, name="actor-async", daemon=True)
         t.start()
+
+    def _start_direct_server(self, actor_id: bytes):
+        """Listen for direct actor calls from other workers/drivers — the
+        worker→worker data path that keeps the head out of the per-call
+        loop (reference analog: CoreWorker's PushTask gRPC service consumed
+        by DirectActorSubmitter, direct_actor_task_submitter.cc)."""
+        import asyncio
+
+        from ray_tpu._private.config import RayConfig
+        from ray_tpu._private.protocol import Connection, MsgType
+
+        if not RayConfig.enable_direct_actor_calls:
+            return
+
+        async def _serve(reader, writer):
+            conn = Connection(reader, writer)
+            try:
+                while True:
+                    msg_type, rid, payload = await conn.read_frame()
+                    if msg_type == MsgType.ACTOR_CALL:
+                        self.task_queue.put(
+                            {"spec": payload["spec"], "direct": (conn, rid)}
+                        )
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                pass
+
+        async def _start():
+            server = await asyncio.start_server(_serve, "0.0.0.0", 0)
+            port = server.sockets[0].getsockname()[1]
+            self._direct_server = server
+            return port
+
+        try:
+            port = self.cw.io.call(_start(), timeout=10)
+            self.cw.request(
+                MsgType.ACTOR_STATE,
+                {"actor_id": actor_id, "direct_addr": f"0.0.0.0:{port}"},
+            )
+        except Exception:
+            traceback.print_exc(file=sys.stderr)  # head path keeps working
 
 
 def _is_async_actor(cls) -> bool:

@@ -173,6 +173,7 @@ class ActorInfo:
         "pending_calls",
         "death_cause",
         "owner_conn_id",
+        "direct_addr",
     )
 
     def __init__(self, spec: TaskSpec):
@@ -189,6 +190,10 @@ class ActorInfo:
         self.pending_calls: List[TaskSpec] = []
         self.death_cause = ""
         self.owner_conn_id: Optional[int] = None
+        # "host:port" of the worker's direct-call server (reference analog:
+        # the worker address a DirectActorSubmitter pushes to,
+        # direct_actor_task_submitter.cc)
+        self.direct_addr: str = ""
 
 
 class PlacementGroupInfo:
@@ -680,6 +685,7 @@ class HeadServer:
             node.release(self._actor_lifetime_resources(actor.creation_spec))
         actor.worker_id = None
         actor.node_id = None
+        actor.direct_addr = ""
         if actor.restarts_used < actor.max_restarts or actor.max_restarts == -1:
             actor.restarts_used += 1
             actor.state = ACTOR_RESTARTING
@@ -1295,6 +1301,7 @@ class HeadServer:
             "actor_id": a.actor_id,
             "state": a.state,
             "creation_spec": a.creation_spec.to_wire(),
+            "direct_addr": a.direct_addr,
         }
 
     async def h_kill_actor(self, cid, conn, p):
@@ -1318,7 +1325,21 @@ class HeadServer:
         a = self.actors.get(p["actor_id"])
         if a is None:
             return {"state": "UNKNOWN"}
-        return {"state": a.state, "death_cause": a.death_cause}
+        if p.get("direct_addr") is not None:
+            # the actor's worker registering its direct-call server; the
+            # worker's node IP is authoritative for the host part
+            host = ""
+            w = self.workers.get(a.worker_id) if a.worker_id else None
+            node = self.nodes.get(w.node_id) if w else None
+            if node is not None and getattr(node, "transfer_addr", None):
+                host = str(node.transfer_addr).rsplit(":", 1)[0]
+            port = str(p["direct_addr"]).rsplit(":", 1)[-1]
+            a.direct_addr = f"{host or '127.0.0.1'}:{port}"
+        return {
+            "state": a.state,
+            "death_cause": a.death_cause,
+            "direct_addr": a.direct_addr,
+        }
 
     async def h_list_actors(self, cid, conn, p):
         out = []

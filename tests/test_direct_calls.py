@@ -1,0 +1,114 @@
+"""Direct actor-call transport (reference analog:
+src/ray/core_worker/transport/direct_actor_task_submitter.cc + the
+in-process memory store for small returns, core_worker.cc:1146).
+
+Calls push straight to the actor's worker over a caller↔worker TCP
+connection; small refless results reply inline into the caller's memory
+store and never touch the head or the shm store."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Echo:
+    def __init__(self):
+        self.calls = 0
+
+    def ping(self, x=0):
+        self.calls += 1
+        return x
+
+    def count(self):
+        return self.calls
+
+    def big(self):
+        return np.zeros(1_000_000)  # over the inline limit → stored path
+
+    def boxed_ref(self):
+        return {"r": ray_tpu.put(np.arange(4.0))}  # ref inside → stored path
+
+
+def test_direct_calls_inline_results(ray_start_regular):
+    e = Echo.remote()
+    assert ray_tpu.get(e.ping.remote(7), timeout=60) == 7
+    # after the first call the handle is on the direct path: the result
+    # must land in the caller's memory store, not the shm store
+    ref = e.ping.remote(42)
+    assert ray_tpu.get(ref, timeout=30) == 42
+    from ray_tpu._private.worker import global_worker
+
+    cw = global_worker.core_worker
+    assert not cw.store.contains(ref.binary()), "inline result leaked to shm store"
+    assert cw._direct_conns, "no direct connection was established"
+
+
+def test_direct_calls_ordering(ray_start_regular):
+    """Sequential actors must observe calls in submission order across the
+    head→direct routing transition."""
+    e = Echo.remote()
+    refs = [e.ping.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(50))
+    assert ray_tpu.get(e.count.remote(), timeout=30) == 50
+
+
+def test_direct_calls_large_result_via_store(ray_start_regular):
+    e = Echo.remote()
+    ray_tpu.get(e.ping.remote(), timeout=60)
+    out = ray_tpu.get(e.big.remote(), timeout=60)
+    assert out.shape == (1_000_000,)
+
+
+def test_direct_calls_ref_result_via_store(ray_start_regular):
+    """Results containing refs go through the store so head containment
+    pinning covers them (no inline shortcut)."""
+    import gc
+
+    e = Echo.remote()
+    ray_tpu.get(e.ping.remote(), timeout=60)
+    box_ref = e.boxed_ref.remote()
+    box = ray_tpu.get(box_ref, timeout=60)
+    del box_ref
+    gc.collect()
+    time.sleep(0.5)
+    assert float(ray_tpu.get(box["r"], timeout=30).sum()) == 6.0
+
+
+def test_direct_result_shippable(ray_start_regular):
+    """A memory-store-only direct result must be promoted when its ref is
+    shipped to another process (task arg)."""
+    e = Echo.remote()
+    ref = e.ping.remote(5)
+    assert ray_tpu.get(ref, timeout=60) == 5
+
+    @ray_tpu.remote
+    def consume(r):
+        return r * 2
+
+    # top-level ARG_REF: worker must be able to resolve it
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 10
+
+    @ray_tpu.remote
+    def consume_nested(box):
+        return ray_tpu.get(box["r"]) * 3
+
+    assert ray_tpu.get(consume_nested.remote({"r": ref}), timeout=60) == 15
+
+
+def test_direct_calls_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def ok(self):
+            return 1
+
+        def boom(self):
+            raise ValueError("direct boom")
+
+    b = Bad.remote()
+    assert ray_tpu.get(b.ok.remote(), timeout=60) == 1
+    with pytest.raises(ValueError, match="direct boom"):
+        ray_tpu.get(b.boom.remote(), timeout=30)

@@ -162,3 +162,65 @@ def test_hung_node_declared_dead_by_heartbeat_timeout(monkeypatch):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_chaos_node_killer_dag_completes(monkeypatch):
+    """NodeKiller chaos (reference analog: test_utils.py:1106
+    get_and_run_node_killer + test_chaos.py:66 test_chaos_task_retry): a
+    background thread SIGKILLs random worker raylets on an interval while a
+    two-stage task DAG runs; retries + lineage must carry the DAG to
+    completion with correct results."""
+    import random
+    import threading
+
+    monkeypatch.setenv("RAY_TPU_HEARTBEAT_PERIOD_MS", "200")
+    monkeypatch.setenv("RAY_TPU_NUM_HEARTBEATS_TIMEOUT", "8")
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        nodes = [c.add_node(num_cpus=2) for _ in range(2)]
+
+        stop = threading.Event()
+        killed = []
+
+        def node_killer():
+            rng = random.Random(0)
+            while not stop.is_set():
+                stop.wait(2.5)
+                if stop.is_set():
+                    break
+                alive = [n for n in nodes if n.proc.poll() is None]
+                if not alive:
+                    break
+                victim = rng.choice(alive)
+                victim.kill(force=True)
+                killed.append(victim.node_id)
+                # keep capacity: replace the dead node
+                nodes.append(c.add_node(num_cpus=2))
+
+        @ray_tpu.remote(max_retries=5)
+        def square(x):
+            import time as t
+
+            t.sleep(0.3)
+            return x * x
+
+        @ray_tpu.remote(max_retries=5)
+        def total(*xs):
+            return sum(xs)
+
+        killer = threading.Thread(target=node_killer, daemon=True)
+        killer.start()
+        try:
+            parts = [square.remote(i) for i in range(12)]
+            out = total.remote(*parts)
+            result = ray_tpu.get(out, timeout=240)
+        finally:
+            stop.set()
+            killer.join(timeout=10)
+        assert result == sum(i * i for i in range(12))
+        assert killed, "chaos thread never killed a node (test too fast?)"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

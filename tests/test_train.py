@@ -69,11 +69,18 @@ def test_single_worker_mlp(ray_cluster):
     assert result.metrics_history[-1]["loss"] < result.metrics_history[0]["loss"]
 
 
-def test_two_worker_dp_gradient_sync(ray_cluster):
+def test_two_worker_dp_gradient_sync(ray_cluster, tmp_path):
     """2-worker data parallelism with dcn-ring gradient allreduce: both
-    workers must hold identical params after each synced step."""
+    workers must hold IDENTICAL params after every synced step, and those
+    params must equal the single-process mean-gradient reference (a broken
+    or skipped allreduce fails both assertions — r2 weak #5)."""
+
+    out_dir = str(tmp_path)
 
     def train_loop(config):
+        import json
+        import os
+
         import jax
         import jax.numpy as jnp
         import optax
@@ -92,25 +99,60 @@ def test_two_worker_dp_gradient_sync(ray_cluster):
         def loss_fn(p):
             return ((x @ p["w"] + p["b"] - y) ** 2).mean()
 
+        sums = []
         for i in range(3):
             grads = jax.grad(loss_fn)(params)
             grads = all_reduce_gradients(grads, group_name=config["group"])
             updates, opt_state = opt.update(grads, opt_state)
             params = optax.apply_updates(params, updates)
-            session.report(
-                {"step": i, "w_sum": float(params["w"].sum()), "rank": rank}
-            )
+            sums.append(float(params["w"].sum()))
+            session.report({"step": i, "w_sum": sums[-1], "rank": rank})
+        with open(os.path.join(config["out_dir"], f"rank{rank}.json"), "w") as f:
+            json.dump(sums, f)
 
     from ray_tpu.train.jax import JaxConfig
 
     trainer = JaxTrainer(
         train_loop,
-        train_loop_config={"group": "_train_dp"},
+        train_loop_config={"group": "_train_dp", "out_dir": out_dir},
         backend_config=JaxConfig(collective_backend="dcn"),
         scaling_config=ScalingConfig(num_workers=2),
     )
     result = trainer.fit()
     assert len(result.metrics_history) == 3
+
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    with open(tmp_path / "rank0.json") as f:
+        sums0 = _json.load(f)
+    with open(tmp_path / "rank1.json") as f:
+        sums1 = _json.load(f)
+    # cross-rank: identical params after every step
+    np.testing.assert_allclose(sums0, sums1, rtol=1e-6)
+
+    # reference: single-process mean of both ranks' gradients
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    expected = []
+    for _ in range(3):
+        grads_by_rank = []
+        for rank in range(2):
+            x = jnp.full((8, 4), float(rank + 1))
+
+            def loss_fn(p):
+                return ((x @ p["w"] + p["b"]) ** 2).mean()
+
+            grads_by_rank.append(jax.grad(loss_fn)(params))
+        mean_grads = jax.tree.map(lambda a, b: (a + b) / 2, *grads_by_rank)
+        updates, opt_state = opt.update(mean_grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        expected.append(float(params["w"].sum()))
+    np.testing.assert_allclose(sums0, expected, rtol=1e-5)
 
 
 def test_checkpoint_roundtrip(ray_cluster):
