@@ -37,6 +37,7 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "direct_call_reorder_wait_s": (float, 2.0, "max wait for an out-of-order direct actor call's predecessors"),
     "object_store_memory": (int, 512 * 1024 * 1024, "default shm store capacity (bytes)"),
     "object_transfer_chunk_bytes": (int, 5 * 1024 * 1024, "chunk size for node-to-node object push"),
+    "object_spilling_enabled": (bool, True, "spill in-scope objects to disk under memory pressure instead of evicting them"),
     "fetch_warn_timeout_s": (float, 30.0, "warn if an object fetch stalls this long"),
     # -- fault tolerance --
     "task_max_retries": (int, 3, "default retries for normal tasks"),

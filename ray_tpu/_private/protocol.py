@@ -67,7 +67,9 @@ class MsgType(enum.IntEnum):
     REMOVE_REF = 46
     PIN_OBJECT = 47
     OBJECT_PULL = 48  # head → raylet: pull oid from a peer's transfer agent
-    OBJECT_DELETE = 49  # head → raylet: drop local copy
+    OBJECT_DELETE = 49  # head → raylet: drop local copy (+ spill files)
+    SPILL_NOTIFY = 90  # any store claimant → head: these oids now live on disk
+    OBJECT_RESTORE = 92  # head → raylet: load a spilled file back into shm
 
     # KV + pubsub (analog: gcs_kv_manager.h, pubsub.proto)
     KV_PUT = 50

@@ -53,6 +53,21 @@ class _Lib:
                 ctypes.c_uint64,
                 ctypes.POINTER(ctypes.c_uint64),
             ]
+            lib.store_alloc_opts.restype = ctypes.c_int
+            lib.store_alloc_opts.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.store_evict_candidates.restype = ctypes.c_int
+            lib.store_evict_candidates.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
             lib.store_seal.restype = ctypes.c_int
             lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.store_get.restype = ctypes.c_int
@@ -62,7 +77,13 @@ class _Lib:
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),
             ]
-            for name in ("store_release", "store_contains", "store_delete", "store_abort"):
+            for name in (
+                "store_release",
+                "store_contains",
+                "store_delete",
+                "store_delete_if_unpinned",
+                "store_abort",
+            ):
                 f = getattr(lib, name)
                 f.restype = ctypes.c_int
                 f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -104,12 +125,22 @@ class _PinnedRegion:
             pass
 
 
+class StoreFullError(MemoryError):
+    """Allocation failed without eviction; the caller's spill hook (if any)
+    should make room and retry."""
+
+
 class ShmObjectStore:
     """One per process; head creates the segment, workers attach."""
 
     def __init__(self, path: str, capacity: int = 0, create: bool = False, nslots: int = 65536):
         self._lib = _Lib.get()
         self._path = path
+        # optional hook: called with (bytes_needed) under memory pressure;
+        # returns True if room was made (spill-to-disk orchestration —
+        # reference analog: LocalObjectManager::SpillObjects triggered
+        # before eviction of referenced data, raylet/local_object_manager.h)
+        self.spill_hook = None
         if create:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._handle = self._lib.store_create(path.encode(), capacity, nslots)
@@ -147,7 +178,7 @@ class ShmObjectStore:
         for b in obj.buffers:
             total += _pad(b.nbytes)
         off = ctypes.c_uint64()
-        rc = self._lib.store_alloc(self._handle, object_id, total, ctypes.byref(off))
+        rc = self._alloc_with_spill(object_id, total, ctypes.byref(off))
         if rc == -1:
             return False
         if rc != 0:
@@ -235,7 +266,7 @@ class ShmObjectStore:
         ObjectBufferPool create-chunk path, object_manager/object_buffer_pool.h)."""
         self._check(object_id)
         off = ctypes.c_uint64()
-        rc = self._lib.store_alloc(self._handle, object_id, size, ctypes.byref(off))
+        rc = self._alloc_with_spill(object_id, size, ctypes.byref(off))
         if rc == -1:
             return None
         if rc != 0:
@@ -244,6 +275,42 @@ class ShmObjectStore:
                 f"(used {self.used()}/{self.capacity()})"
             )
         return self._mv[off.value : off.value + size]
+
+    def _alloc_with_spill(self, object_id: bytes, size: int, off_ref) -> int:
+        """Allocate, preferring spill-to-disk over LRU eviction when a
+        spill hook is wired: in-scope objects must not be silently dropped
+        to make room (they'd need lineage reconstruction to come back)."""
+        if self.spill_hook is None:
+            return self._lib.store_alloc(self._handle, object_id, size, off_ref)
+        if size + _ALIGN > self.capacity():
+            # can never fit even after padding: fail without churning the
+            # working set to disk
+            return -2
+        for _ in range(3):
+            rc = self._lib.store_alloc_opts(self._handle, object_id, size, 0, off_ref)
+            if rc != -2:
+                return rc
+            try:
+                made_room = self.spill_hook(size)
+            except Exception:
+                made_room = False
+            if not made_room:
+                break
+        # last resort: evicting alloc (out-of-scope data goes first by LRU)
+        return self._lib.store_alloc(self._handle, object_id, size, off_ref)
+
+    def evict_candidates(self, max_n: int = 64) -> List[tuple]:
+        """LRU-first (object_id, size) pairs that are sealed and unpinned —
+        what a spill pass would move to disk."""
+        if not self._handle:
+            return []
+        ids = ctypes.create_string_buffer(max_n * self.ID_LEN)
+        sizes = (ctypes.c_uint64 * max_n)()
+        n = self._lib.store_evict_candidates(self._handle, max_n, ids, sizes)
+        out = []
+        for i in range(max(0, n)):
+            out.append((ids.raw[i * self.ID_LEN : (i + 1) * self.ID_LEN], int(sizes[i])))
+        return out
 
     def raw_seal(self, object_id: bytes):
         if self._lib.store_seal(self._handle, object_id) != 0:
@@ -266,6 +333,13 @@ class ShmObjectStore:
     def delete(self, object_id: bytes):
         if self._handle:
             self._lib.store_delete(self._handle, object_id)
+
+    def delete_if_unpinned(self, object_id: bytes) -> bool:
+        """Delete unless a reader pins it (spill path safety); True if the
+        shm copy is gone."""
+        if not self._handle:
+            return False
+        return self._lib.store_delete_if_unpinned(self._handle, object_id) == 0
 
     def capacity(self) -> int:
         return self._lib.store_capacity(self._handle) if self._handle else 0

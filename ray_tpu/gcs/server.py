@@ -269,6 +269,10 @@ class HeadServer:
         # oid -> set of node_ids holding a sealed copy (analog: reference
         # OwnershipBasedObjectDirectory location sets)
         self.object_locations: Dict[bytes, set] = {}
+        # oid -> (node_id, path): objects whose only durable copy is a
+        # spill file on that node's disk (reference analog: spilled-URL
+        # tracking, raylet/local_object_manager.h)
+        self.object_spilled: Dict[bytes, tuple] = {}
         # (oid, dest_node) -> future, coalescing concurrent pull requests
         self._pull_inflight: Dict[Tuple[bytes, bytes], asyncio.Future] = {}
         # lineage: return oid -> producing TaskSpec, byte-budgeted FIFO
@@ -323,6 +327,25 @@ class HeadServer:
         from ray_tpu.raylet.object_agent import ObjectTransferAgent
 
         self._store = ShmObjectStore(self.store_path, capacity=self.store_capacity, create=True)
+        if RayConfig.object_spilling_enabled:
+            loop = asyncio.get_running_loop()
+            spill_dir = self.store_path + ".spill"
+
+            def _head_spill_hook(need: int) -> bool:
+                # fires on whatever thread hit pressure (restore runs in an
+                # executor; the agent's pulls run on the loop); registry
+                # updates are marshalled back onto the loop
+                from ray_tpu.raylet.spill import spill_batch
+
+                spilled = spill_batch(self._store, int(need), spill_dir)
+                if not spilled:
+                    return False
+                loop.call_soon_threadsafe(
+                    self._record_spills, self.head_node_id, spilled
+                )
+                return True
+
+            self._store.spill_hook = _head_spill_hook
         # the head node participates in the transfer mesh like any raylet;
         # advertise a dialable address (bind wildcard → route-based self-IP)
         self.object_agent = ObjectTransferAgent(self._store)
@@ -822,6 +845,13 @@ class HeadServer:
         dest) and run as their own task so a timed-out waiter never cancels
         the transfer for other waiters."""
         locs = self.object_locations.get(oid)
+        if not locs and oid in self.object_spilled:
+            # only durable copy is a spill file: restore it into its node's
+            # shm first, then transfer normally
+            err = await self._restore_spilled(oid)
+            if err is not None:
+                return err
+            locs = self.object_locations.get(oid)
         if not locs:
             return f"ObjectLostError: {oid.hex()[:16]} sealed but no live copy"
         if dest_nid in locs:
@@ -846,6 +876,22 @@ class HeadServer:
             return "__timeout__"
 
     async def _pull_to_node(self, oid: bytes, dest_nid: bytes) -> Optional[str]:
+        err = await self._pull_to_node_once(oid, dest_nid)
+        if err is None or not err.startswith("ObjectLostError"):
+            return err
+        # a spill may have raced the pull (the holder deleted its shm copy
+        # and its SPILL_NOTIFY is in flight): give the notify a beat, then
+        # restore-and-retry once before declaring the object lost
+        await asyncio.sleep(0.3)
+        if oid in self.object_spilled:
+            rerr = await self._restore_spilled(oid)
+            if rerr is None:
+                if dest_nid in self.object_locations.get(oid, ()):
+                    return None
+                return await self._pull_to_node_once(oid, dest_nid)
+        return err
+
+    async def _pull_to_node_once(self, oid: bytes, dest_nid: bytes) -> Optional[str]:
         last_err = "no live copy"
         for src_nid in list(self.object_locations.get(oid, ())):
             src = self.nodes.get(src_nid)
@@ -956,7 +1002,8 @@ class HeadServer:
                 f.cancel()
 
     def _delete_everywhere(self, oid: bytes):
-        """Drop all copies: head store directly, remote nodes by directive."""
+        """Drop all copies: head store directly, remote nodes by directive
+        (including any spill file)."""
         locs = self.object_locations.pop(oid, set())
         for nid in locs:
             if nid == self.head_node_id:
@@ -967,9 +1014,84 @@ class HeadServer:
                     asyncio.get_running_loop().create_task(
                         node.conn.send(MsgType.OBJECT_DELETE, {"object_ids": [oid]})
                     )
+        spilled = self.object_spilled.pop(oid, None)
+        if spilled is not None:
+            snid, path = spilled
+            if snid == self.head_node_id:
+                from ray_tpu.raylet.spill import delete_spilled
+
+                delete_spilled(path)
+            else:
+                node = self.nodes.get(snid)
+                if node is not None and node.conn is not None:
+                    asyncio.get_running_loop().create_task(
+                        node.conn.send(
+                            MsgType.OBJECT_DELETE,
+                            {"object_ids": [], "spill_paths": [path]},
+                        )
+                    )
         # even with no recorded location (pre-location legacy puts), try head
         if not locs:
             self._store.delete(oid)
+
+    # --------------------------------------------------------------- spilling
+
+    async def h_spill_notify(self, cid, conn, p):
+        """A store claimant on `node_id` moved these objects to its disk
+        (ray_tpu/raylet/spill.py); record the spill locations and drop the
+        now-gone shm locations (reference analog: spilled-URL updates to
+        the owner, raylet/local_object_manager.h)."""
+        nid = bytes(p["node_id"]) if p.get("node_id") else self.head_node_id
+        self._record_spills(nid, {bytes(k): v for k, v in (p.get("spilled") or {}).items()})
+        return {"ok": True}
+
+    def _record_spills(self, nid: bytes, spilled: Dict[bytes, str]):
+        for oid, path in spilled.items():
+            self.object_spilled[oid] = (nid, path)
+            locs = self.object_locations.get(oid)
+            if locs is not None:
+                locs.discard(nid)
+                if not locs:
+                    del self.object_locations[oid]
+
+    async def _restore_spilled(self, oid: bytes) -> Optional[str]:
+        """Bring a spilled object back into its node's shm store."""
+        snid, path = self.object_spilled.get(oid, (None, None))
+        if snid is None:
+            return f"ObjectLostError: {oid.hex()[:16]} has no spilled copy"
+        if snid == self.head_node_id:
+            from ray_tpu.raylet.spill import delete_spilled, restore_object
+
+            def _restore_and_clean():
+                ok = restore_object(self._store, oid, path)
+                if ok:
+                    delete_spilled(path)  # back in shm; don't leak the file
+                return ok
+
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, _restore_and_clean
+            )
+        else:
+            node = self.nodes.get(snid)
+            if node is None or node.conn is None or not node.alive:
+                return (
+                    f"ObjectLostError: spill node {snid.hex()[:8]} for "
+                    f"{oid.hex()[:16]} is gone"
+                )
+            try:
+                reply = await node.conn.request(
+                    MsgType.OBJECT_RESTORE,
+                    {"object_id": oid, "path": path},
+                    timeout=300,
+                )
+                ok = bool(reply.get("ok"))
+            except Exception:  # noqa: BLE001
+                ok = False
+        if not ok:
+            return f"ObjectLostError: restore of {oid.hex()[:16]} failed"
+        self.object_spilled.pop(oid, None)
+        self._add_location(oid, snid)
+        return None
 
     async def h_free_object(self, cid, conn, p):
         for oid in p["object_ids"]:
@@ -1879,6 +2001,7 @@ HeadServer._HANDLERS = {
     MsgType.FREE_OBJECT: HeadServer.h_free_object,
     MsgType.ADD_REF: HeadServer.h_add_ref,
     MsgType.REMOVE_REF: HeadServer.h_remove_ref,
+    MsgType.SPILL_NOTIFY: HeadServer.h_spill_notify,
     MsgType.KV_PUT: HeadServer.h_kv_put,
     MsgType.KV_GET: HeadServer.h_kv_get,
     MsgType.KV_DEL: HeadServer.h_kv_del,

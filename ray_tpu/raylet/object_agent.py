@@ -115,7 +115,11 @@ class ObjectTransferAgent:
             found, size = _HDR.unpack(hdr)
             if not found:
                 return False
-            view = self.store.raw_create(oid, size)
+            # raw_create may trigger the spill hook (blocking disk writes):
+            # run it off-loop so heartbeats/RPCs keep flowing mid-spill
+            view = await asyncio.get_running_loop().run_in_executor(
+                None, self.store.raw_create, oid, size
+            )
             if view is None:
                 return True  # raced another path; already present
             got = 0

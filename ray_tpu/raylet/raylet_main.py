@@ -48,6 +48,30 @@ class Raylet:
         self.store = ShmObjectStore(
             self.store_path, capacity=RayConfig.object_store_memory, create=True
         )
+        if RayConfig.object_spilling_enabled:
+            loop = asyncio.get_running_loop()
+            spill_dir = self.store_path + ".spill"
+
+            def _spill_hook(need: int) -> bool:
+                # runs on whichever thread hit pressure (agent pulls run on
+                # the loop itself); notify is scheduled, never awaited here
+                from ray_tpu.raylet.spill import spill_batch
+
+                spilled = spill_batch(self.store, int(need), spill_dir)
+                if not spilled:
+                    return False
+                conn = getattr(self, "conn", None)
+                if conn is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        conn.send(
+                            MsgType.SPILL_NOTIFY,
+                            {"node_id": self.node_id.binary(), "spilled": spilled},
+                        ),
+                        loop,
+                    )
+                return True
+
+            self.store.spill_hook = _spill_hook
         self.object_agent = ObjectTransferAgent(self.store)
         transfer_port = await self.object_agent.start()
         advertise = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
@@ -98,6 +122,15 @@ class Raylet:
                 elif msg_type == MsgType.OBJECT_DELETE:
                     for oid in payload.get("object_ids", []):
                         self.store.delete(bytes(oid))
+                    if payload.get("spill_paths"):
+                        from ray_tpu.raylet.spill import delete_spilled
+
+                        for path in payload["spill_paths"]:
+                            delete_spilled(path)
+                elif msg_type == MsgType.OBJECT_RESTORE:
+                    asyncio.get_running_loop().create_task(
+                        self._handle_restore(conn, rid, payload)
+                    )
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -114,6 +147,23 @@ class Raylet:
                 await conn.reply(rid, {"ok": False, "error": f"{type(e).__name__}: {e}"})
             except Exception:
                 pass
+
+    async def _handle_restore(self, conn: Connection, rid: int, payload: dict):
+        from ray_tpu.raylet.spill import delete_spilled, restore_object
+
+        oid, path = bytes(payload["object_id"]), payload["path"]
+
+        def _do():
+            ok = restore_object(self.store, oid, path)
+            if ok:
+                delete_spilled(path)  # back in shm; don't leak the file
+            return ok
+
+        ok = await asyncio.get_running_loop().run_in_executor(None, _do)
+        try:
+            await conn.reply(rid, {"ok": bool(ok)})
+        except Exception:
+            pass
 
     def _spawn_worker(self, tpu: bool = False):
         self._worker_seq += 1

@@ -16,9 +16,11 @@
 //
 // Build: g++ -O2 -shared -fPIC -o libray_tpu_store.so store.cc -lpthread
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 #include <fcntl.h>
 #include <pthread.h>
 #include <sys/mman.h>
@@ -268,7 +270,12 @@ void store_detach(void* sp) {
 
 // Allocate an object; returns 0 ok (offset from segment base in *out_offset),
 // -1 already exists, -2 out of memory, -3 table full.
-int store_alloc(void* sp, const uint8_t* id, uint64_t size, uint64_t* out_offset) {
+// allow_evict=0 never drops other objects to make room (-2 instead): the
+// spilling path uses this so in-scope data is spilled to disk by policy
+// rather than silently deleted by LRU (reference analog: spilling runs
+// BEFORE eviction of referenced objects, raylet/local_object_manager.h).
+int store_alloc_opts(void* sp, const uint8_t* id, uint64_t size, int allow_evict,
+                     uint64_t* out_offset) {
   Store* s = (Store*)sp;
   Locker lock(s->hdr);
   Slot* existing = find_slot(s, id, false);
@@ -277,6 +284,7 @@ int store_alloc(void* sp, const uint8_t* id, uint64_t size, uint64_t* out_offset
   if (need > s->hdr->capacity) return -2;
   uint64_t off = alloc_block(s, need);
   while (off == UINT64_MAX) {
+    if (!allow_evict) return -2;
     if (evict_one(s) == 0) return -2;
     off = alloc_block(s, need);
   }
@@ -294,6 +302,38 @@ int store_alloc(void* sp, const uint8_t* id, uint64_t size, uint64_t* out_offset
   s->hdr->num_objects++;
   *out_offset = (uint64_t)(s->data - (uint8_t*)s->base) + off;
   return 0;
+}
+
+int store_alloc(void* sp, const uint8_t* id, uint64_t size, uint64_t* out_offset) {
+  return store_alloc_opts(sp, id, size, 1, out_offset);
+}
+
+// List up to max_n spill/eviction candidates (sealed, unpinned), least
+// recently used first.  out_ids receives max_n*kIdLen bytes, out_sizes the
+// payload sizes.  Returns the count written.
+int store_evict_candidates(void* sp, uint64_t max_n, uint8_t* out_ids,
+                           uint64_t* out_sizes) {
+  Store* s = (Store*)sp;
+  Locker lock(s->hdr);
+  struct Cand {
+    Slot* sl;
+    uint64_t tick;
+  };
+  std::vector<Cand> cands;
+  for (uint64_t i = 0; i < s->hdr->nslots; i++) {
+    Slot* sl = &s->slots[i];
+    if (sl->state == SEALED && sl->refcount == 0) {
+      cands.push_back({sl, sl->lru_tick});
+    }
+  }
+  std::sort(cands.begin(), cands.end(),
+            [](const Cand& a, const Cand& b) { return a.tick < b.tick; });
+  uint64_t n = cands.size() < max_n ? cands.size() : max_n;
+  for (uint64_t i = 0; i < n; i++) {
+    memcpy(out_ids + i * kIdLen, cands[i].sl->id, kIdLen);
+    out_sizes[i] = cands[i].sl->size;
+  }
+  return (int)n;
 }
 
 int store_seal(void* sp, const uint8_t* id) {
@@ -342,6 +382,21 @@ int store_delete(void* sp, const uint8_t* id) {
   Locker lock(s->hdr);
   Slot* sl = find_slot(s, id, false);
   if (!sl || sl->state == TOMBSTONE) return -1;
+  free_block(s, sl->offset, align_up(sl->size));
+  sl->state = TOMBSTONE;
+  s->hdr->num_objects--;
+  return 0;
+}
+
+// Delete only if no reader currently pins the object (spill path: a pinned
+// zero-copy view must never have its backing block freed under it).
+// 0 deleted, -1 missing, -2 pinned.
+int store_delete_if_unpinned(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Locker lock(s->hdr);
+  Slot* sl = find_slot(s, id, false);
+  if (!sl || sl->state == TOMBSTONE) return -1;
+  if (sl->refcount > 0) return -2;
   free_block(s, sl->offset, align_up(sl->size));
   sl->state = TOMBSTONE;
   s->hdr->num_objects--;
