@@ -67,6 +67,22 @@ def run(deployment_obj: Deployment, *, _blocking: bool = False, http_port: Optio
     from ray_tpu.serve.handle import DeploymentHandle
 
     controller = _get_or_create_controller()
+    # definition version computed HERE, where the original objects live —
+    # the controller only sees deserialized copies, so identity comparison
+    # there is meaningless (reference analog: deployment version strings)
+    import hashlib
+
+    import cloudpickle
+
+    def_version = hashlib.sha1(
+        cloudpickle.dumps(
+            (
+                deployment_obj.func_or_class,
+                deployment_obj.init_args,
+                deployment_obj.init_kwargs,
+            )
+        )
+    ).hexdigest()
     ray_tpu.get(
         controller.deploy.remote(
             deployment_obj.name,
@@ -78,6 +94,7 @@ def run(deployment_obj: Deployment, *, _blocking: bool = False, http_port: Optio
             deployment_obj.route_prefix,
             deployment_obj.autoscaling_config,
             deployment_obj.max_concurrent_queries,
+            def_version,
         ),
         timeout=300,
     )

@@ -61,6 +61,22 @@ class ServeController:
         self.deployments: Dict[str, dict] = {}
         self.version = 0
 
+    def _publish_update(self, name: str):
+        """Push the version bump to every handle (reference analog:
+        LongPollHost notifying LongPollClients, _private/long_poll.py:184).
+        Handles mark themselves stale and re-pull on their next request."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.protocol import MsgType
+
+        try:
+            cw = worker_mod._require_connected()
+            cw.request(
+                MsgType.PUBLISH,
+                {"channel": f"serve:{name}", "message": {"version": self.version}},
+            )
+        except Exception:
+            pass  # handles still converge via their pull path
+
     def deploy(
         self,
         name: str,
@@ -72,10 +88,14 @@ class ServeController:
         route_prefix: Optional[str],
         autoscaling_config: Optional[dict],
         max_concurrent_queries: int,
+        def_version: str = "",
     ):
+        import time as _time
+
         import ray_tpu
 
         dep = self.deployments.get(name)
+        redeploy = False
         if dep is None:
             dep = {
                 "name": name,
@@ -85,26 +105,66 @@ class ServeController:
                 "autoscaling": autoscaling_config,
             }
             self.deployments[name] = dep
+        else:
+            # version-gated rolling update ONLY when the definition changed
+            # (caller-computed hash — the objects we hold are deserialized
+            # copies, so identity checks are meaningless here); a plain
+            # scale-up/down keeps warm replicas
+            redeploy = bool(def_version) and dep.get("def_version") != def_version
         dep["target"] = num_replicas
         dep["cls"] = cls_or_fn
         dep["init_args"] = init_args
         dep["init_kwargs"] = init_kwargs
         dep["actor_options"] = ray_actor_options or {}
-        self._reconcile(name)
+        dep["max_concurrent_queries"] = max_concurrent_queries
+        dep["def_version"] = def_version
+        old = []
+        if redeploy:
+            old = self._rolling_replace(name)
+        else:
+            self._reconcile(name)
         self.version += 1
+        self._publish_update(name)
+        if old:
+            # grace window: let handles process the publish and cut over
+            # before the previous generation dies
+            _time.sleep(1.0)
+            for victim in old:
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
         return True
+
+    def _spawn_replica(self, dep: dict):
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(Replica)
+        return actor_cls.options(**dict(dep["actor_options"])).remote(
+            dep["cls"], dep["init_args"], dep["init_kwargs"]
+        )
+
+    def _rolling_replace(self, name: str) -> list:
+        """Spin up the new generation, wait until it answers, swap it in,
+        and RETURN the old replicas — the caller kills them only after the
+        version publish (+grace), so handles never route to a dead set."""
+        import ray_tpu
+
+        dep = self.deployments[name]
+        fresh = [self._spawn_replica(dep) for _ in range(dep["target"])]
+        try:
+            ray_tpu.get([r.stats.remote() for r in fresh], timeout=120)
+        except Exception:
+            pass  # serve whatever came up; reconcile repairs stragglers
+        old, dep["replicas"] = dep["replicas"], fresh
+        return old
 
     def _reconcile(self, name: str):
         import ray_tpu
 
         dep = self.deployments[name]
-        actor_cls = ray_tpu.remote(Replica)
         while len(dep["replicas"]) < dep["target"]:
-            opts = dict(dep["actor_options"])
-            replica = actor_cls.options(**opts).remote(
-                dep["cls"], dep["init_args"], dep["init_kwargs"]
-            )
-            dep["replicas"].append(replica)
+            dep["replicas"].append(self._spawn_replica(dep))
         while len(dep["replicas"]) > dep["target"]:
             victim = dep["replicas"].pop()
             try:
@@ -150,6 +210,7 @@ class ServeController:
                 dep["target"] = desired
                 self._reconcile(name)
                 self.version += 1
+                self._publish_update(name)
         return self.version
 
     def delete_deployment(self, name: str):
@@ -163,6 +224,7 @@ class ServeController:
                 except Exception:
                     pass
         self.version += 1
+        self._publish_update(name)
         return True
 
     def list_deployments(self):

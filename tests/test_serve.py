@@ -131,3 +131,60 @@ def test_http_proxy(ray_cluster):
                 raise
             time.sleep(1)
     assert body["result"] == 42
+
+
+def test_many_concurrent_requests_stable_threads(ray_cluster):
+    """A few hundred concurrent requests must not spawn a thread per
+    request: in-flight accounting resolves on the core worker's io loop
+    (r2 weak #6 — the old handle started one daemon thread per .remote())."""
+    import threading
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind())
+    # warm up (replicas live, direct conns open)
+    assert ray_tpu.get(handle.remote(0), timeout=120) == 0
+
+    before = threading.active_count()
+    refs = [handle.remote(i) for i in range(300)]
+    during = threading.active_count()
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == list(range(300))
+    # allow a little noise (gc flush, timers), but nothing like 300 threads
+    assert during - before < 20, f"thread count grew {before}->{during}"
+    # the in-flight counters must drain back to ~zero (callbacks fired)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if sum(handle._inflight.values()) == 0:
+            break
+        time.sleep(0.2)
+    assert sum(handle._inflight.values()) == 0
+
+
+def test_config_update_propagates_to_live_handle(ray_cluster):
+    """Redeploying a changed definition must reach an EXISTING handle via
+    the serve:<name> pubsub push — no new handle, no manual refresh
+    (reference analog: LongPollHost/Client, _private/long_poll.py:67)."""
+
+    @serve.deployment(name="versioned")
+    def v1(x):
+        return ("v1", x)
+
+    handle = serve.run(v1.bind())
+    assert tuple(ray_tpu.get(handle.remote(1), timeout=120)) == ("v1", 1)
+
+    @serve.deployment(name="versioned")
+    def v2(x):
+        return ("v2", x)
+
+    serve.run(v2.bind())  # rolling replace publishes the version bump
+    deadline = time.time() + 60
+    while True:
+        got = tuple(ray_tpu.get(handle.remote(2), timeout=60))
+        if got == ("v2", 2):
+            break
+        assert got == ("v1", 2)  # old generation may serve during rollout
+        assert time.time() < deadline, "handle never saw the new version"
+        time.sleep(0.5)
