@@ -71,7 +71,12 @@ class PPO(Algorithm):
         obs_dim = int(np.prod(env.observation_space.shape))
         num_actions = int(env.action_space.n)
         del env
-        policy_config = {"lr": config.lr, "clip_param": config.clip_param, "entropy_coeff": config.entropy_coeff}
+        policy_config = {
+            "lr": config.lr,
+            "clip_param": config.clip_param,
+            "entropy_coeff": config.entropy_coeff,
+            "gamma": config.gamma,
+        }
         # the learner lives driver-side (on TPU: owns the chips; BASELINE
         # config #3's "TPU learner"), rollout workers are cpu actors
         self.policy = JaxPolicy(

@@ -1,0 +1,220 @@
+"""IMPALA: asynchronous actor-learner with V-trace correction.
+
+Analog of the reference's async learner pipeline (reference:
+rllib/execution/learner_thread.py:17 LearnerThread,
+multi_gpu_learner_thread.py:20 + :184 _MultiGPULoaderThread — the loader
+overlaps host→device copies with the learner's compute).  Rollout actors
+stream fragments continuously with whatever weights they last received;
+the driver feeds a host queue; a loader thread stages each fragment onto
+the learner's device (host→HBM prefetch) while the learner thread updates
+on the previous one; V-trace (ray_tpu/rllib/policy.py learn_on_fragment)
+corrects the policy lag.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS, SampleBatch
+
+
+@dataclass
+class IMPALAConfig(AlgorithmConfig):
+    # learner updates per train() call
+    num_batches_per_iter: int = 8
+    # refresh the broadcast weights after this many learner updates
+    broadcast_interval: int = 1
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class _LoaderThread(threading.Thread):
+    """Stages host fragments onto the learner's device ahead of use
+    (reference: _MultiGPULoaderThread, multi_gpu_learner_thread.py:184)."""
+
+    def __init__(self, host_q: "queue.Queue", device_q: "queue.Queue"):
+        super().__init__(name="impala-loader", daemon=True)
+        self.host_q = host_q
+        self.device_q = device_q
+        self.stopped = False
+
+    def run(self):
+        import jax
+
+        while not self.stopped:
+            try:
+                item = self.host_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None:
+                self.device_q.put(None)
+                return
+            batch, bootstrap = item
+            staged = SampleBatch(
+                {
+                    OBS: jax.device_put(batch[OBS].astype(np.float32)),
+                    ACTIONS: jax.device_put(batch[ACTIONS].astype(np.int32)),
+                    LOGPS: jax.device_put(batch[LOGPS].astype(np.float32)),
+                    REWARDS: jax.device_put(batch[REWARDS].astype(np.float32)),
+                    DONES: jax.device_put(batch[DONES].astype(np.float32)),
+                }
+            )
+            # bounded put that honors stop: the learner may already be gone
+            while not self.stopped:
+                try:
+                    self.device_q.put((staged, bootstrap), timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+
+
+class _LearnerThread(threading.Thread):
+    """Consumes device-staged fragments, applies the V-trace update
+    (reference: LearnerThread, learner_thread.py:17)."""
+
+    def __init__(self, policy, device_q: "queue.Queue"):
+        super().__init__(name="impala-learner", daemon=True)
+        self.policy = policy
+        self.device_q = device_q
+        self.num_updates = 0
+        self.last_metrics: Dict[str, float] = {}
+        self.error: Optional[BaseException] = None
+        self.stopped = False
+
+    def run(self):
+        while not self.stopped:
+            try:
+                item = self.device_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            batch, bootstrap = item
+            try:
+                self.last_metrics = self.policy.learn_on_fragment(batch, bootstrap)
+            except Exception as e:  # noqa: BLE001
+                # surface to the driver (train() raises) instead of dying
+                # silently and hanging the update-count loop
+                self.error = e
+                self.num_updates += 1
+                continue
+            self.num_updates += 1
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config: IMPALAConfig):
+        super().__init__(config)
+        from ray_tpu.rllib.policy import JaxPolicy
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        env = config.env_creator()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        del env
+        policy_config = {
+            "lr": config.lr,
+            "clip_param": config.clip_param,
+            "entropy_coeff": config.entropy_coeff,
+            "gamma": config.gamma,
+        }
+        self.policy = JaxPolicy(
+            obs_dim=obs_dim, num_actions=num_actions, seed=config.seed, **policy_config
+        )
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env_creator, policy_config, seed=config.seed + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        self._inflight: Dict[Any, Any] = {}  # sample ref -> worker
+        self._host_q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._device_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._loader = _LoaderThread(self._host_q, self._device_q)
+        self._learner = _LearnerThread(self.policy, self._device_q)
+        self._loader.start()
+        self._learner.start()
+        self._weights_ref = None
+        self._weights_at_update = -1
+
+    def _current_weights_ref(self):
+        if (
+            self._weights_ref is None
+            or self._learner.num_updates - self._weights_at_update
+            >= self.config.broadcast_interval
+        ):
+            self._weights_ref = ray_tpu.put(self.policy.get_weights())
+            self._weights_at_update = self._learner.num_updates
+        return self._weights_ref
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.time()
+        target = self._learner.num_updates + cfg.num_batches_per_iter
+        steps = 0
+        # prime: every worker keeps exactly one fragment in flight
+        for w in self.workers:
+            if w not in self._inflight.values():
+                self._inflight[
+                    w.sample_fragment.remote(cfg.rollout_fragment_length)
+                ] = w
+        while self._learner.num_updates < target:
+            if self._learner.error is not None:
+                raise RuntimeError("IMPALA learner failed") from self._learner.error
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=60
+            )
+            if not ready:
+                continue
+            ref = ready[0]
+            w = self._inflight.pop(ref)
+            batch, bootstrap = ray_tpu.get(ref, timeout=60)
+            steps += len(batch)
+            self._host_q.put((batch, bootstrap))
+            # async continuation: latest weights out, next fragment in
+            w.set_weights.remote(self._current_weights_ref())
+            self._inflight[
+                w.sample_fragment.remote(cfg.rollout_fragment_length)
+            ] = w
+
+        if self._learner.error is not None:
+            # the final update of the iteration may have been the failing one
+            raise RuntimeError("IMPALA learner failed") from self._learner.error
+        stats = ray_tpu.get(
+            [w.episode_stats.remote() for w in self.workers], timeout=120
+        )
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_this_iter": steps,
+            "num_learner_updates": self._learner.num_updates,
+            "episode_reward_mean": float(
+                np.mean(
+                    [s["episode_reward_mean"] for s in stats if s["episodes"] > 0]
+                    or [0.0]
+                )
+            ),
+            "episodes_total": int(sum(s["episodes"] for s in stats)),
+            "time_this_iter_s": time.time() - t0,
+            **self._learner.last_metrics,
+        }
+
+    def stop(self):
+        self._loader.stopped = True
+        self._learner.stopped = True
+        try:
+            self._host_q.put_nowait(None)  # wake the loader if idle; both
+        except queue.Full:  # threads also exit via their stopped flags
+            pass
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -3,10 +3,14 @@
 The reference stubs a JAX model path but never built the learner
 (reference: rllib/models/jax/jax_modelv2.py, fcnet.py — "JAX stub models",
 SURVEY §2.5); its real learners are torch towers
-(rllib/policy/torch_policy.py:60, learn_on_loaded_batch:538).  This is the
-full JAX realization: MLP π/V, categorical head, clipped-surrogate PPO
-loss, one jitted update — on TPU the same step pmap/pjit-s over chips.
-"""
+(rllib/policy/torch_policy.py:60, learn_on_loaded_batch:538 splitting the
+batch across model_gpu_towers :221-230).  This is the full JAX
+realization: MLP π/V, categorical head, clipped-surrogate PPO loss, one
+jitted update — and with ``num_devices > 1`` the update is one pjit
+program over a 1-D device mesh: the batch shards across devices, params
+replicate, and XLA inserts the gradient all-reduce (the tower-stack's
+TPU-native equivalent, with the compiler doing the averaging the
+reference does in threads)."""
 
 from __future__ import annotations
 
@@ -47,7 +51,9 @@ class JaxPolicy:
         clip_param: float = 0.2,
         vf_coeff: float = 0.5,
         entropy_coeff: float = 0.0,
+        gamma: float = 0.99,
         seed: int = 0,
+        num_devices: int = 1,
     ):
         import jax
         import jax.numpy as jnp
@@ -66,6 +72,8 @@ class JaxPolicy:
         self.clip_param = clip_param
         self.vf_coeff = vf_coeff
         self.entropy_coeff = entropy_coeff
+        self.gamma = gamma
+        self.num_devices = max(1, num_devices)
         self._rng = jax.random.PRNGKey(seed + 1)
 
         @jax.jit
@@ -76,18 +84,22 @@ class JaxPolicy:
             logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), action]
             return action, logp, value
 
-        @jax.jit
-        def _update(params, opt_state, obs, actions, old_logp, advantages, returns):
+        def _update(params, opt_state, obs, actions, old_logp, advantages, returns, mask):
             def loss_fn(p):
+                # masked means: padded rows (multi-device batch rounding)
+                # carry zero weight, so padding never biases the update
+                def wmean(x):
+                    return (x * mask).sum() / mask.sum()
+
                 logits = _mlp_apply(p["pi"], obs)
                 logp_all = jax.nn.log_softmax(logits)
                 logp = logp_all[jnp.arange(obs.shape[0]), actions]
                 ratio = jnp.exp(logp - old_logp)
                 clipped = jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param)
-                pi_loss = -jnp.minimum(ratio * advantages, clipped * advantages).mean()
+                pi_loss = -wmean(jnp.minimum(ratio * advantages, clipped * advantages))
                 value = _mlp_apply(p["vf"], obs)[..., 0]
-                vf_loss = ((value - returns) ** 2).mean()
-                entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+                vf_loss = wmean((value - returns) ** 2)
+                entropy = wmean(-(jnp.exp(logp_all) * logp_all).sum(-1))
                 total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
                 return total, {
                     "policy_loss": pi_loss,
@@ -103,8 +115,29 @@ class JaxPolicy:
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
+        if self.num_devices > 1:
+            # one pjit program over a 1-D mesh: batch rows shard across
+            # devices (P("dp")), params/opt replicate — the mean-reductions
+            # in the loss become XLA cross-device all-reduces
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            devices = jax.devices()[: self.num_devices]
+            self._mesh = Mesh(np.array(devices), ("dp",))
+            rep = NamedSharding(self._mesh, P())
+            row = NamedSharding(self._mesh, P("dp"))
+            self._batch_sharding = row
+            self._update = jax.jit(
+                _update,
+                in_shardings=(rep, rep, row, row, row, row, row, row),
+                out_shardings=(rep, rep, None),
+            )
+        else:
+            self._mesh = None
+            self._batch_sharding = None
+            self._update = jax.jit(_update)
+
         self._forward = _forward
-        self._update = _update
+        self._vtrace_update = None  # built lazily (IMPALA path)
 
     # ------------------------------------------------------------- serving
 
@@ -118,16 +151,114 @@ class JaxPolicy:
     def learn_on_batch(self, batch) -> Dict[str, float]:
         from ray_tpu.rllib.sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
 
-        self.params, self.opt_state, metrics = self._update(
-            self.params,
-            self.opt_state,
+        n = len(batch[OBS])
+        mask = np.ones(n, np.float32)
+        arrays = (
             batch[OBS].astype(np.float32),
             batch[ACTIONS].astype(np.int32),
             batch[LOGPS].astype(np.float32),
             batch[ADVANTAGES].astype(np.float32),
             batch[RETURNS].astype(np.float32),
+            mask,
+        )
+        if self.num_devices > 1:
+            # pad rows to a multiple of the mesh so the shard is even; the
+            # mask zeroes the padded rows out of every loss mean (cycled
+            # indices: rem may exceed n for tiny batches)
+            rem = (-n) % self.num_devices
+            if rem:
+                pad_idx = np.arange(rem) % n
+                arrays = tuple(np.concatenate([a, a[pad_idx]]) for a in arrays)
+                arrays = arrays[:-1] + (
+                    np.concatenate([mask, np.zeros(rem, np.float32)]),
+                )
+            import jax
+
+            arrays = tuple(
+                jax.device_put(a, self._batch_sharding) for a in arrays
+            )
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, *arrays
         )
         return {k: float(v) for k, v in metrics.items()}
+
+    def learn_on_fragment(self, batch, bootstrap_value: float) -> Dict[str, float]:
+        """IMPALA/V-trace update on one time-ordered rollout fragment
+        (off-policy: behavior logps correct the policy lag).  Reference
+        analog: the IMPALA learner's vtrace loss consumed by
+        rllib/execution/learner_thread.py:17."""
+        from ray_tpu.rllib.sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS
+
+        if self._vtrace_update is None:
+            self._vtrace_update = self._build_vtrace_update()
+        self.params, self.opt_state, metrics = self._vtrace_update(
+            self.params,
+            self.opt_state,
+            batch[OBS].astype(np.float32),
+            batch[ACTIONS].astype(np.int32),
+            batch[LOGPS].astype(np.float32),
+            batch[REWARDS].astype(np.float32),
+            batch[DONES].astype(np.float32),
+            np.float32(bootstrap_value),
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def _build_vtrace_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma = self.gamma
+        rho_bar = c_bar = 1.0
+
+        def update(params, opt_state, obs, actions, behavior_logp, rewards, dones, bootstrap):
+            def loss_fn(p):
+                T = obs.shape[0]
+                logits = _mlp_apply(p["pi"], obs)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = logp_all[jnp.arange(T), actions]
+                values = _mlp_apply(p["vf"], obs)[..., 0]
+
+                rho = jnp.minimum(jnp.exp(logp - behavior_logp), rho_bar)
+                c = jnp.minimum(rho, c_bar)
+                nonterminal = 1.0 - dones
+                next_values = jnp.concatenate([values[1:], bootstrap[None]])
+                deltas = rho * (rewards + gamma * nonterminal * next_values - values)
+
+                # vs_t = V_t + delta_t + gamma*nt_t*c_t*(vs_{t+1} - V_{t+1});
+                # reverse scan carries (vs_{t+1} - V_{t+1})
+                def body(carry, xs):
+                    delta, c_t, nt = xs
+                    acc = delta + gamma * nt * c_t * carry
+                    return acc, acc
+
+                _, acc = jax.lax.scan(
+                    body, jnp.float32(0.0), (deltas, c, nonterminal), reverse=True
+                )
+                vs = values + acc
+                next_vs = jnp.concatenate([vs[1:], bootstrap[None]])
+                # v-trace targets are fixed targets, not differentiated
+                vs = jax.lax.stop_gradient(vs)
+                pg_adv = jax.lax.stop_gradient(
+                    rho * (rewards + gamma * nonterminal * next_vs - values)
+                )
+                pi_loss = -(logp * pg_adv).mean()
+                vf_loss = ((values - vs) ** 2).mean()
+                entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+                total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+                return total, {
+                    "policy_loss": pi_loss,
+                    "vf_loss": vf_loss,
+                    "entropy": entropy,
+                }
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        return jax.jit(update)
 
     def get_weights(self):
         import jax

@@ -60,12 +60,12 @@ class RolloutWorker:
             **policy_config,
         )
         self._obs, _ = self.env.reset(seed=seed)
-        self.gamma = 0.99
+        self.gamma = policy_config.get("gamma", 0.99)  # GAE discount
         self.lam = 0.95
         self.episode_rewards = []
         self._ep_reward = 0.0
 
-    def sample(self, num_steps: int) -> SampleBatch:
+    def _rollout(self, num_steps: int):
         rows = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VALUES)}
         for _ in range(num_steps):
             obs = np.asarray(self._obs, np.float32).reshape(-1)
@@ -90,7 +90,16 @@ class RolloutWorker:
         # bootstrap value for the unfinished tail
         obs = np.asarray(self._obs, np.float32).reshape(-1)
         _, _, last_value = self.policy.compute_actions(obs[None])
-        return compute_gae(batch, float(last_value[0]), self.gamma, self.lam)
+        return batch, float(last_value[0])
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        batch, last_value = self._rollout(num_steps)
+        return compute_gae(batch, last_value, self.gamma, self.lam)
+
+    def sample_fragment(self, num_steps: int):
+        """IMPALA: raw time-ordered fragment + bootstrap value, no GAE —
+        the learner applies V-trace with the recorded behavior logps."""
+        return self._rollout(num_steps)
 
     def set_weights(self, weights):
         self.policy.set_weights(weights)
