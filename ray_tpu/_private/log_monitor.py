@@ -1,0 +1,85 @@
+"""Log monitor: tail worker log files, push new lines to the driver.
+
+Analog of the reference's log_monitor process (reference:
+python/ray/_private/log_monitor.py — tails per-process files in the
+session tmp dir and publishes via GCS pubsub; the driver prints them with
+a (pid=…) prefix).  Here a tailer thread runs inside the head process
+(and inside each raylet for its node's workers) publishing to the
+``logs`` pubsub channel; drivers subscribe at init when log_to_driver.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Callable, Dict, List
+
+
+class LogTailer(threading.Thread):
+    """Polls ``<dir>/worker-*.log`` files and publishes new complete lines
+    via ``publish({source, lines})``."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        publish: Callable[[dict], None],
+        pattern: str = "worker-*.log",
+        poll_s: float = 0.5,
+    ):
+        super().__init__(name="log-monitor", daemon=True)
+        self.log_dir = log_dir
+        self.pattern = pattern
+        self.publish = publish
+        self.poll_s = poll_s
+        self.stopped = threading.Event()
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+
+    def run(self):
+        while not self.stopped.wait(self.poll_s):
+            try:
+                self.scan_once()
+            except Exception:
+                pass
+
+    def scan_once(self):
+        for path in glob.glob(os.path.join(self.log_dir, self.pattern)):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(path, 0)
+            if size <= off:
+                continue
+            try:
+                # binary reads: byte offsets never drift on multibyte
+                # characters split across polls (decode happens per line)
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            self._offsets[path] = off + len(chunk)
+            data = self._partial.pop(path, b"") + chunk
+            parts = data.split(b"\n")
+            if parts and parts[-1] != b"":
+                self._partial[path] = parts[-1]
+            lines = [
+                p.decode("utf-8", errors="replace") for p in parts[:-1] if p
+            ]
+            if lines:
+                self.publish(
+                    {"source": os.path.basename(path), "lines": lines}
+                )
+
+    def stop(self):
+        self.stopped.set()
+
+
+def print_log_message(msg: dict):
+    """Driver-side default sink: the reference's (pid=…) prefix style."""
+    src = msg.get("source", "worker")
+    for line in msg.get("lines", []):
+        print(f"({src}) {line}", flush=True)

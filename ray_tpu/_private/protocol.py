@@ -70,6 +70,11 @@ class MsgType(enum.IntEnum):
     OBJECT_DELETE = 49  # head → raylet: drop local copy (+ spill files)
     SPILL_NOTIFY = 90  # any store claimant → head: these oids now live on disk
     OBJECT_RESTORE = 92  # head → raylet: load a spilled file back into shm
+    # Ray-Client-style remote drivers (no mmap of any node's store): object
+    # payloads ride the control connection (analog: reference
+    # util/client/ dataclient streaming, ray_client.proto)
+    CLIENT_PUT = 93
+    CLIENT_GET = 94
 
     # KV + pubsub (analog: gcs_kv_manager.h, pubsub.proto)
     KV_PUT = 50

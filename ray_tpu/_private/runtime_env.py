@@ -1,0 +1,172 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Analog of the reference's runtime_env stack (reference:
+python/ray/_private/runtime_env/{plugin.py,working_dir.py,py_modules.py,
+pip.py,conda.py} — plugins set up an env on the executing node; code
+packages travel as zips through GCS KV).  Plugin registry with:
+
+- env_vars: applied in-process before execution
+- working_dir: local path → chdir; non-existent on the worker's node →
+  uploaded as a zip through the head KV at submit, extracted per worker
+- py_modules: module files/dirs zipped through the head KV, placed on
+  sys.path in the worker
+- pip / conda: interface present; this image is a fixed TPU-VM base with
+  no package egress, so setup raises with that explanation (the
+  reference's dashboard-agent conda/pip builders assume an installer the
+  image deliberately lacks)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, List
+
+_MAX_PACKAGE_BYTES = 100 << 20
+
+
+def _zip_path(path: str) -> bytes:
+    """Zip a file or directory tree into bytes (reference analog:
+    _private/runtime_env/packaging.py create_package)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            zf.write(path, os.path.basename(path))
+        else:
+            base = os.path.basename(os.path.normpath(path))
+            for root, _dirs, files in os.walk(path):
+                for f in files:
+                    if f.endswith(".pyc") or "__pycache__" in root:
+                        continue
+                    full = os.path.join(root, f)
+                    rel = os.path.join(base, os.path.relpath(full, path))
+                    zf.write(full, rel)
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES}); ship data via the object store instead"
+        )
+    return data
+
+
+def _tree_stamp(path: str) -> tuple:
+    """Cheap change detector for the upload cache: (path, mtime of the
+    newest file, file count)."""
+    if os.path.isfile(path):
+        st = os.stat(path)
+        return (path, st.st_mtime_ns, 1)
+    newest, count = 0, 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                m = os.stat(os.path.join(root, f)).st_mtime_ns
+            except OSError:
+                continue
+            newest = max(newest, m)
+            count += 1
+    return (path, newest, count)
+
+
+def _upload_package(cw, path: str) -> str:
+    # per-driver cache: submitting 1000 tasks with the same working_dir
+    # must not zip + ship the tree 1000 times
+    cache = getattr(cw, "_runtime_env_pkg_cache", None)
+    if cache is None:
+        cache = cw._runtime_env_pkg_cache = {}
+    stamp = _tree_stamp(path)
+    key = cache.get(stamp)
+    if key is not None:
+        return key
+    data = _zip_path(path)
+    key = f"runtime_env:{hashlib.sha1(data).hexdigest()}"
+    cw.kv_put(key, data, overwrite=False)
+    cache[stamp] = key
+    return key
+
+
+def process_runtime_env(cw, renv: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver-side: validate + upload local code so the worker (possibly on
+    another node) can materialize it.  Returns the wire form."""
+    if not renv:
+        return {}
+    unknown = set(renv) - {
+        "env_vars",
+        "working_dir",
+        "py_modules",
+        "pip",
+        "conda",
+        "container",
+    }
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
+    out = dict(renv)
+    wd = renv.get("working_dir")
+    if wd and os.path.exists(wd):
+        # upload so remote nodes (no shared FS assumed) get the same tree;
+        # the local path is kept as a fast path for same-node workers
+        out["working_dir_key"] = _upload_package(cw, wd)
+    mods = renv.get("py_modules")
+    if mods:
+        keys = []
+        for m in mods:
+            if not os.path.exists(m):
+                raise FileNotFoundError(f"py_modules path not found: {m}")
+            keys.append(_upload_package(cw, m))
+        out["py_modules_keys"] = keys
+    return out
+
+
+def apply_runtime_env(cw, renv: Dict[str, Any], session_dir: str = ""):
+    """Worker-side: materialize the env before executing user code
+    (reference analog: RuntimeEnvContext.exec_worker, context.py:46 —
+    ours mutates the live process instead of re-execing)."""
+    if not renv:
+        return
+    if renv.get("pip") or renv.get("conda") or renv.get("container"):
+        raise RuntimeError(
+            "pip/conda/container runtime envs need a package installer; this "
+            "TPU-VM image is fixed and has no package egress — bake deps into "
+            "the image or use py_modules for pure-python code"
+        )
+    for k, v in (renv.get("env_vars") or {}).items():
+        os.environ[str(k)] = str(v)
+    stage_root = os.path.join(
+        session_dir or tempfile.gettempdir(), "runtime_env_staging"
+    )
+    for key in renv.get("py_modules_keys") or []:
+        target = _materialize(cw, key, stage_root)
+        if target not in sys.path:
+            sys.path.insert(0, target)
+    wd = renv.get("working_dir")
+    if wd:
+        if not os.path.isdir(wd) and renv.get("working_dir_key"):
+            wd = _materialize(cw, renv["working_dir_key"], stage_root, flatten=True)
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+
+
+def _materialize(cw, key: str, stage_root: str, flatten: bool = False) -> str:
+    """Download + extract a KV package once per key (content-addressed)."""
+    target = os.path.join(stage_root, key.split(":", 1)[1])
+    marker = target + ".done"
+    if not os.path.exists(marker):
+        data = cw.kv_get(key)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {key} missing from KV")
+        os.makedirs(target, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(target)
+        with open(marker, "w") as f:
+            f.write("ok")
+    if flatten:
+        # a working_dir zip holds one top-level dir: chdir inside it
+        entries = [e for e in os.listdir(target) if not e.endswith(".done")]
+        if len(entries) == 1 and os.path.isdir(os.path.join(target, entries[0])):
+            return os.path.join(target, entries[0])
+    return target

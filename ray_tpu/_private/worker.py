@@ -114,6 +114,22 @@ def init(
     global_worker.mode = "driver"
     global_worker.address = f"{host}:{port}"
     global_worker.namespace = namespace
+    from collections import deque
+
+    global_worker.captured_logs = deque(maxlen=1000)  # bounded ring, test hook
+    if log_to_driver:
+        # worker stdout/stderr stream to the driver with a (source) prefix
+        # (reference: log_monitor.py → pubsub → driver print)
+        from ray_tpu._private.log_monitor import print_log_message
+
+        def _on_log(msg: dict):
+            global_worker.captured_logs.extend(msg.get("lines", []))
+            print_log_message(msg)
+
+        try:
+            cw.subscribe("logs", _on_log)
+        except Exception:
+            pass
     atexit.register(shutdown)
     return RuntimeContext(global_worker)
 

@@ -160,6 +160,8 @@ class CoreWorker:
         self._subscriptions: Dict[str, List[Callable[[dict], None]]] = {}
         self.connected = False
 
+        self.is_client = False  # remote driver without a local store mmap
+        self._client_promoted: set = set()
         self.io = _EventLoopThread()
         self.conn: Connection = self.io.call(
             Connection.connect(head_host, head_port, RayConfig.connect_timeout_s)
@@ -196,7 +198,9 @@ class CoreWorker:
                     else:
                         self._early_pushes.append(payload)
                 elif msg_type == MsgType.PUBLISH:
-                    for cb in self._subscriptions.get(payload.get("channel", ""), []):
+                    # iterate a snapshot: callbacks may unsubscribe
+                    # themselves (weakref pruning) during the fan-out
+                    for cb in list(self._subscriptions.get(payload.get("channel", ""), [])):
                         try:
                             cb(payload.get("message", {}))
                         except Exception:
@@ -305,6 +309,18 @@ class CoreWorker:
         # refs to memory-store-only values (direct-call results) must be
         # globally resolvable once they leave this process
         self._promote_memory_objects(sobj.contained)
+        if self.store is None:
+            # client mode: the payload rides the head connection and lands
+            # in the head node's store (seal included server-side)
+            self.request(
+                MsgType.CLIENT_PUT,
+                {
+                    "object_id": oid,
+                    "value": sobj.to_wire(),
+                    "contained": sobj.contained,
+                },
+            )
+            return
         if not self.store.put_serialized(oid, sobj):
             pass  # already present (idempotent put)
         # contained refs ride the seal message so the head pins the inner
@@ -313,6 +329,21 @@ class CoreWorker:
             MsgType.PUT_OBJECT,
             {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
         )
+
+    def _client_fetch(
+        self, oid: bytes, deadline: Optional[float]
+    ) -> Optional[SerializedObject]:
+        rem = None if deadline is None else max(0.0, deadline - time.monotonic())
+        reply = self.request(
+            MsgType.CLIENT_GET,
+            {"object_id": oid, "timeout": rem},
+            timeout=(rem + 10) if rem is not None else 3600,
+        )
+        if reply.get("state") == "timeout":
+            raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
+        if reply.get("state") == "error":
+            raise _error_from_string(reply.get("error", "object fetch failed"))
+        return SerializedObject.from_wire(reply["value"])
 
     def _promote_memory_objects(self, oids: Sequence[bytes]):
         """Make memory-store-only values (inline direct-call results)
@@ -327,9 +358,25 @@ class CoreWorker:
                 # shipped ref is resolvable wherever it goes
                 self._resolve_direct(oid, None)
             sobj = self._memory_store.get(oid)
-            if sobj is None or self.store is None or self.store.contains(oid):
+            if sobj is None:
                 continue
             self._promote_memory_objects(sobj.contained)
+            if self.store is None:
+                # client mode: ship the payload through the head (once)
+                if oid in self._client_promoted:
+                    continue
+                self._client_promoted.add(oid)
+                self.request(
+                    MsgType.CLIENT_PUT,
+                    {
+                        "object_id": oid,
+                        "value": sobj.to_wire(),
+                        "contained": sobj.contained,
+                    },
+                )
+                continue
+            if self.store.contains(oid):
+                continue
             self.store.put_serialized(oid, sobj)
             self.request(
                 MsgType.PUT_OBJECT,
@@ -389,9 +436,12 @@ class CoreWorker:
                         raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
                     if state == "error":
                         raise _error_from_string(reply.get("error", "task failed"))
-                    sobj = self.store.get_serialized(oid)
-                    if sobj is None:
-                        sobj = self._refetch_evicted(oid, deadline)
+                    if self.store is None:
+                        sobj = self._client_fetch(oid, deadline)
+                    else:
+                        sobj = self.store.get_serialized(oid)
+                        if sobj is None:
+                            sobj = self._refetch_evicted(oid, deadline)
                     out[i] = self._materialize(sobj)
             finally:
                 self._notify_blocked(False)
@@ -454,7 +504,9 @@ class CoreWorker:
         direct_ids = []
         for i, ref in enumerate(refs):
             oid = ref.binary()
-            if oid in self._memory_store or self.store.contains(oid):
+            if oid in self._memory_store or (
+                self.store is not None and self.store.contains(oid)
+            ):
                 ready_idx.add(i)
             elif oid in self._direct_pending:
                 direct_ids.append((i, oid))
@@ -470,7 +522,9 @@ class CoreWorker:
                     still = []
                     for i, oid in direct_ids:
                         if oid not in self._direct_pending:
-                            if oid in self._memory_store or self.store.contains(oid):
+                            if oid in self._memory_store or (
+                                self.store is not None and self.store.contains(oid)
+                            ):
                                 ready_idx.add(i)
                             else:
                                 pending_ids.append((i, oid))
@@ -526,6 +580,10 @@ class CoreWorker:
         node_affinity: Optional[bytes] = None,
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
+        if runtime_env:
+            from ray_tpu._private.runtime_env import process_runtime_env
+
+            runtime_env = process_runtime_env(self, runtime_env)
         task_id = TaskID.for_normal_task(self.job_id)
         encoded_args, nested_refs = self._encode_args(args, kwargs)
         spec = TaskSpec(
@@ -567,6 +625,11 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
     ) -> ObjectRef:
         from ray_tpu._private.ids import ActorID
+
+        if runtime_env:
+            from ray_tpu._private.runtime_env import process_runtime_env
+
+            runtime_env = process_runtime_env(self, runtime_env)
 
         task_id = TaskID.for_actor_creation(ActorID(actor_id))
         encoded_args, nested_refs = self._encode_args(args, kwargs)
@@ -959,7 +1022,14 @@ class CoreWorker:
             },
         )
         self.node_id = reply["node_id"]
-        self.attach_store(reply["store_path"])
+        store_path = reply["store_path"]
+        force_client = bool(os.environ.get("RAY_TPU_FORCE_CLIENT"))
+        if os.path.exists(store_path) and not force_client:
+            self.attach_store(store_path)
+        else:
+            # remote driver (Ray-Client mode, reference: util/client/): no
+            # node store to mmap — object payloads ride the head connection
+            self.is_client = True
         return reply
 
     def task_done(

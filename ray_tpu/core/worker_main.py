@@ -242,21 +242,17 @@ class WorkerRuntime:
             os._exit(1)  # lost the head: die, the head treats it as worker death
 
     def _apply_runtime_env(self, spec: TaskSpec):
-        """env_vars + working_dir (reference: _private/runtime_env/ —
-        theirs sets up dedicated workers via the agent; here the worker
-        applies the env in-process before execution; conda/pip isolation
-        is out of scope on a fixed TPU-VM image and raises)."""
-        renv = spec.runtime_env or {}
-        unsupported = set(renv) - {"env_vars", "working_dir"}
-        if unsupported:
-            raise ValueError(f"unsupported runtime_env keys: {sorted(unsupported)}")
-        for k, v in (renv.get("env_vars") or {}).items():
-            os.environ[str(k)] = str(v)
-        wd = renv.get("working_dir")
-        if wd:
-            os.chdir(wd)
-            if wd not in sys.path:
-                sys.path.insert(0, wd)
+        """env_vars / working_dir / py_modules materialized in-process
+        before execution (reference: _private/runtime_env/ — theirs sets
+        up dedicated workers via the agent; pip/conda raise on this fixed
+        TPU-VM image, see _private/runtime_env.py)."""
+        from ray_tpu._private.runtime_env import apply_runtime_env
+
+        apply_runtime_env(
+            self.cw,
+            spec.runtime_env or {},
+            session_dir=os.path.dirname(os.environ.get("RAY_TPU_STORE_PATH", "")),
+        )
 
     def _execute(self, spec: TaskSpec):
         self.cw.current_task_id = spec.task_id

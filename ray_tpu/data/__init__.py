@@ -5,3 +5,12 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_numpy,
     range,
 )
+from ray_tpu.data.datasource import (  # noqa: F401
+    read_csv,
+    read_json,
+    read_parquet,
+    write_csv,
+    write_json,
+    write_parquet,
+)
+from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401

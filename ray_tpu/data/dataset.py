@@ -193,6 +193,33 @@ class Dataset:
         if buf:
             yield _to_batch(buf, batch_format)
 
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Streamed execution over windows of blocks (reference:
+        data/dataset_pipeline.py via Dataset.window)."""
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, blocks_per_window)
+
+    def repeat(self, times: int) -> "DatasetPipeline":
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, max(1, len(self._blocks))).repeat(times)
+
+    def write_parquet(self, dir_path: str):
+        from ray_tpu.data.datasource import write_parquet
+
+        return write_parquet(self, dir_path)
+
+    def write_csv(self, dir_path: str):
+        from ray_tpu.data.datasource import write_csv
+
+        return write_csv(self, dir_path)
+
+    def write_json(self, dir_path: str):
+        from ray_tpu.data.datasource import write_json
+
+        return write_json(self, dir_path)
+
     def num_blocks(self) -> int:
         return len(self._blocks)
 

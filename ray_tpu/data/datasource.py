@@ -1,0 +1,140 @@
+"""File datasources: parquet / csv / json(lines) read + write.
+
+Analog of the reference's datasource layer (reference:
+python/ray/data/datasource/{parquet_datasource.py,csv_datasource.py,
+json_datasource.py} + read_api.py read_parquet/read_csv/read_json and
+Dataset.write_*): one read task per file (a block per file), one write
+task per block.  Blocks stay in the row format the rest of this Data
+layer uses (list of dicts); pyarrow handles the columnar conversion at
+the file boundary.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+from typing import List, Optional, Union
+
+import ray_tpu
+
+
+def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p)) if f.endswith(suffix)
+            )
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no {suffix} files under {paths}")
+    return out
+
+
+def _rows_to_table(rows: List[dict]):
+    import pyarrow as pa
+
+    if rows and not isinstance(rows[0], dict):
+        rows = [{"value": r} for r in rows]
+    return pa.Table.from_pylist(rows)
+
+
+@ray_tpu.remote
+def _read_parquet_file(path: str, columns):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path, columns=columns).to_pylist()
+
+
+@ray_tpu.remote
+def _read_csv_file(path: str):
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path).to_pylist()
+
+
+@ray_tpu.remote
+def _read_json_file(path: str):
+    import json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+@ray_tpu.remote
+def _write_parquet_block(block, path: str):
+    import pyarrow.parquet as pq
+
+    pq.write_table(_rows_to_table(block), path)
+    return path
+
+
+@ray_tpu.remote
+def _write_csv_block(block, path: str):
+    import pyarrow.csv as pacsv
+
+    pacsv.write_csv(_rows_to_table(block), path)
+    return path
+
+
+@ray_tpu.remote
+def _write_json_block(block, path: str):
+    import json
+
+    with open(path, "w") as f:
+        for row in block:
+            f.write(json.dumps(row) + "\n")
+    return path
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None):
+    """One block per file (reference: read_api.py read_parquet)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, ".parquet")
+    return Dataset([_read_parquet_file.remote(p, columns) for p in files])
+
+
+def read_csv(paths):
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, ".csv")
+    return Dataset([_read_csv_file.remote(p) for p in files])
+
+
+def read_json(paths):
+    """JSON-lines files (reference: read_api.py read_json)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, ".json")
+    return Dataset([_read_json_file.remote(p) for p in files])
+
+
+def _write(ds, dir_path: str, writer, ext: str) -> List[str]:
+    os.makedirs(dir_path, exist_ok=True)
+    refs = []
+    for i, block in enumerate(ds._blocks):
+        refs.append(
+            writer.remote(block, os.path.join(dir_path, f"part-{i:05d}{ext}"))
+        )
+    return ray_tpu.get(refs, timeout=600)
+
+
+def write_parquet(ds, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, _write_parquet_block, ".parquet")
+
+
+def write_csv(ds, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, _write_csv_block, ".csv")
+
+
+def write_json(ds, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, _write_json_block, ".json")

@@ -1,0 +1,105 @@
+"""DatasetPipeline: windowed streaming execution over blocks.
+
+Analog of the reference's DatasetPipeline (reference:
+python/ray/data/dataset_pipeline.py; created via Dataset.window /
+Dataset.repeat): transforms are recorded lazily and applied one window at
+a time, so a training loop consumes window k while window k+1's transform
+tasks execute — bounded memory over arbitrarily large datasets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional
+
+
+class DatasetPipeline:
+    def __init__(self, windows: List["Any"], stages: Optional[List[Callable]] = None):
+        # windows: list of (untransformed) Datasets; stages: Dataset->Dataset
+        self._windows = windows
+        self._stages = stages or []
+
+    # ---------------------------------------------------------- construction
+
+    @staticmethod
+    def from_dataset(ds, blocks_per_window: int = 2) -> "DatasetPipeline":
+        from ray_tpu.data.dataset import Dataset
+
+        windows = [
+            Dataset(ds._blocks[i : i + blocks_per_window])
+            for i in range(0, len(ds._blocks), blocks_per_window)
+        ]
+        return DatasetPipeline(windows)
+
+    def repeat(self, times: int) -> "DatasetPipeline":
+        """Epoch looping (reference: DatasetPipeline.repeat)."""
+        return DatasetPipeline(list(self._windows) * times, list(self._stages))
+
+    # ------------------------------------------------------------ transforms
+
+    def _with_stage(self, stage: Callable) -> "DatasetPipeline":
+        return DatasetPipeline(self._windows, self._stages + [stage])
+
+    def map(self, fn) -> "DatasetPipeline":
+        return self._with_stage(lambda ds: ds.map(fn))
+
+    def map_batches(self, fn, *, batch_format: str = "numpy") -> "DatasetPipeline":
+        return self._with_stage(lambda ds: ds.map_batches(fn, batch_format=batch_format))
+
+    def filter(self, fn) -> "DatasetPipeline":
+        return self._with_stage(lambda ds: ds.filter(fn))
+
+    def random_shuffle_each_window(self, seed: Optional[int] = None) -> "DatasetPipeline":
+        return self._with_stage(lambda ds: ds.random_shuffle(seed))
+
+    # ------------------------------------------------------------ execution
+
+    def _transformed(self) -> Iterator[Any]:
+        """Yield transformed windows with one window of read-ahead: window
+        k+1's tasks are submitted before window k is consumed."""
+        pending = None
+        for w in self._windows:
+            nxt = w
+            for stage in self._stages:
+                nxt = stage(nxt)  # submits tasks; results are futures
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
+
+    def iter_windows(self) -> Iterator[Any]:
+        return self._transformed()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ds in self._transformed():
+            yield from ds.iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy"):
+        from ray_tpu.data.dataset import _to_batch
+
+        buf: List[Any] = []
+        for ds in self._transformed():
+            for row in ds.iter_rows():
+                buf.append(row)
+                if len(buf) >= batch_size:
+                    yield _to_batch(buf, batch_format)
+                    buf = []
+        if buf:
+            yield _to_batch(buf, batch_format)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(ds.count() for ds in self._transformed())
+
+    def __repr__(self):
+        return (
+            f"DatasetPipeline(windows={len(self._windows)}, "
+            f"stages={len(self._stages)})"
+        )

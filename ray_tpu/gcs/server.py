@@ -360,6 +360,21 @@ class HeadServer:
 
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+
+        # tail this node's worker logs → "logs" pubsub channel (analog:
+        # reference log_monitor.py; drivers subscribe when log_to_driver)
+        from ray_tpu._private.log_monitor import LogTailer
+
+        loop = asyncio.get_running_loop()
+
+        def _publish_logs(msg: dict):
+            asyncio.run_coroutine_threadsafe(self._publish("logs", msg), loop)
+
+        # head-spawned workers only — raylets tail their own node's files
+        self._log_tailer = LogTailer(
+            self.session_dir, _publish_logs, pattern="worker-head-*.log"
+        )
+        self._log_tailer.start()
         # table persistence: restore surviving metadata from a prior head
         # incarnation (detached actors restart on fresh workers), then keep
         # snapshotting (analog: reference gcs_table_storage.h → Redis)
@@ -1035,6 +1050,40 @@ class HeadServer:
             self._store.delete(oid)
 
     # --------------------------------------------------------------- spilling
+
+    async def h_client_put(self, cid, conn, p):
+        """Remote driver (Ray-Client mode) put: the payload rode the
+        control connection; store it in the head node's store and seal
+        (reference analog: util/client dataclient put)."""
+        from ray_tpu._private.serialization import SerializedObject
+
+        oid = bytes(p["object_id"])
+        sobj = SerializedObject.from_wire(p["value"])
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._store.put_serialized, oid, sobj
+        )
+        self._pin_contained(oid, p.get("contained") or [])
+        self._add_location(oid, self.head_node_id)
+        await self._seal_object(oid)
+        return {"ok": True}
+
+    async def h_client_get(self, cid, conn, p):
+        """Remote driver get: wait for seal, pull the object to the head
+        node, return the payload over the control connection."""
+        oid = bytes(p["object_id"])
+        reply = await self.h_wait_object(
+            cid,
+            conn,
+            {"object_id": oid, "timeout": p.get("timeout"), "node_id": self.head_node_id},
+        )
+        if reply.get("state") != "sealed":
+            return reply
+        sobj = await asyncio.get_running_loop().run_in_executor(
+            None, self._store.get_serialized, oid
+        )
+        if sobj is None:
+            return {"state": "error", "error": f"ObjectLostError: {oid.hex()[:16]}"}
+        return {"state": "sealed", "value": sobj.to_wire()}
 
     async def h_spill_notify(self, cid, conn, p):
         """A store claimant on `node_id` moved these objects to its disk
@@ -1928,7 +1977,7 @@ class HeadServer:
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env.pop("RAY_TPU_WORKER_TPU", None)
-        log = os.path.join(self.session_dir, f"worker-{self._next_worker_seq}.log")
+        log = os.path.join(self.session_dir, f"worker-head-{self._next_worker_seq}.log")
         with open(log, "ab") as logf:
             subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker_main"],
@@ -2002,6 +2051,8 @@ HeadServer._HANDLERS = {
     MsgType.ADD_REF: HeadServer.h_add_ref,
     MsgType.REMOVE_REF: HeadServer.h_remove_ref,
     MsgType.SPILL_NOTIFY: HeadServer.h_spill_notify,
+    MsgType.CLIENT_PUT: HeadServer.h_client_put,
+    MsgType.CLIENT_GET: HeadServer.h_client_get,
     MsgType.KV_PUT: HeadServer.h_kv_put,
     MsgType.KV_GET: HeadServer.h_kv_get,
     MsgType.KV_DEL: HeadServer.h_kv_del,

@@ -91,6 +91,27 @@ class Raylet:
             },
         )
         assert reply.get("ok")
+
+        # tail this node's worker logs and relay to the head's "logs"
+        # channel (analog: reference log_monitor.py per node)
+        from ray_tpu._private.log_monitor import LogTailer
+
+        loop = asyncio.get_running_loop()
+
+        def _publish_logs(msg: dict):
+            asyncio.run_coroutine_threadsafe(
+                conn.send(
+                    MsgType.PUBLISH, {"channel": "logs", "message": msg}
+                ),
+                loop,
+            )
+
+        self._log_tailer = LogTailer(
+            self.session_dir,
+            _publish_logs,
+            pattern=f"worker-{self.node_id.hex()[:8]}-*.log",
+        )
+        self._log_tailer.start()
         print(f"NODE {self.node_id.hex()}", flush=True)
         await reply_fut
 

@@ -118,6 +118,9 @@ class ServeController:
         dep["actor_options"] = ray_actor_options or {}
         dep["max_concurrent_queries"] = max_concurrent_queries
         dep["def_version"] = def_version
+        if route_prefix is not None:
+            dep["route_prefix"] = route_prefix
+        dep["autoscaling"] = autoscaling_config
         old = []
         if redeploy:
             old = self._rolling_replace(name)
@@ -126,15 +129,38 @@ class ServeController:
         self.version += 1
         self._publish_update(name)
         if old:
-            # grace window: let handles process the publish and cut over
-            # before the previous generation dies
-            _time.sleep(1.0)
-            for victim in old:
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
+            # retire the previous generation OFF the actor's call path: the
+            # controller must keep serving get_handles (handles are
+            # refreshing right now because of the publish above).  The
+            # retirer waits out a cut-over grace, then drains in-flight
+            # requests (bounded) before killing.
+            import threading
+
+            threading.Thread(
+                target=self._retire_replicas, args=(old,), daemon=True
+            ).start()
         return True
+
+    def _retire_replicas(self, old: list):
+        import time as _time
+
+        import ray_tpu
+
+        _time.sleep(1.0)  # publish propagation grace
+        deadline = _time.time() + 30.0
+        while _time.time() < deadline:
+            try:
+                stats = ray_tpu.get([r.stats.remote() for r in old], timeout=10)
+            except Exception:
+                break  # old replicas already dying; just kill
+            if all(s["inflight"] == 0 for s in stats):
+                break
+            _time.sleep(0.5)
+        for victim in old:
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
 
     def _spawn_replica(self, dep: dict):
         import ray_tpu

@@ -22,6 +22,10 @@ from typing import Any, Dict, List
 
 
 class DeploymentHandle:
+    # push is the fast path; this pull interval is the self-heal fallback
+    # for a missed publish (failed subscribe, dropped PUBLISH RPC)
+    PULL_FALLBACK_S = 5.0
+
     def __init__(self, deployment_name: str, controller):
         self._name = deployment_name
         self._controller = controller
@@ -29,9 +33,13 @@ class DeploymentHandle:
         self._max_inflight = 100
         self._version = -1
         self._rr = itertools.count()
-        self._inflight: Dict[int, int] = {}
+        # keyed by replica actor id (NOT slot index): releases after a
+        # membership change must decrement the replica that actually served
+        self._inflight: Dict[Any, int] = {}
         self._lock = threading.Lock()
         self._stale = threading.Event()
+        self._last_refresh = 0.0
+        self._last_refresh_attempt = 0.0
         self._refresh()
         self._subscribe_updates()
 
@@ -67,24 +75,43 @@ class DeploymentHandle:
             pass
 
     def _refresh(self):
+        import time as _time
+
         import ray_tpu
 
         info = ray_tpu.get(self._controller.get_handles.remote(self._name), timeout=30)
         if info is None:
             raise ValueError(f"no deployment named {self._name!r}")
         with self._lock:
+            # identity-keyed counters survive membership changes untouched;
+            # drop entries for replicas that left the set
             self._replicas = info["replicas"]
             self._max_inflight = info["max_concurrent_queries"]
             self._version = info["version"]
-            self._inflight = {}
+            live = {self._rid(r) for r in self._replicas}
+            self._inflight = {
+                k: v for k, v in self._inflight.items() if k in live
+            }
+        self._last_refresh = _time.monotonic()
         self._stale.clear()
 
+    @staticmethod
+    def _rid(replica):
+        return getattr(replica, "_actor_id", id(replica))
+
     def _pick_replica(self):
-        if self._stale.is_set():
+        import time as _time
+
+        now = _time.monotonic()
+        need = self._stale.is_set() or now - self._last_refresh > self.PULL_FALLBACK_S
+        # attempt backoff: a dead controller must not add a blocking RPC to
+        # every request while the stale flag is stuck set
+        if need and now - self._last_refresh_attempt > 1.0:
+            self._last_refresh_attempt = now
             try:
                 self._refresh()  # clears _stale on success
             except Exception:
-                pass  # stale stays set: the NEXT request retries
+                pass  # a later request (post-backoff) retries
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -92,18 +119,21 @@ class DeploymentHandle:
             # round-robin, skipping replicas at their in-flight cap
             for _ in range(n):
                 idx = next(self._rr) % n
-                if self._inflight.get(idx, 0) < self._max_inflight:
-                    self._inflight[idx] = self._inflight.get(idx, 0) + 1
-                    return idx, self._replicas[idx]
+                rid = self._rid(self._replicas[idx])
+                if self._inflight.get(rid, 0) < self._max_inflight:
+                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                    return rid, self._replicas[idx]
             # all saturated: take the round-robin pick anyway (backpressure
             # belongs to the replica's queue)
             idx = next(self._rr) % n
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            return idx, self._replicas[idx]
+            rid = self._rid(self._replicas[idx])
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            return rid, self._replicas[idx]
 
-    def _release(self, idx: int):
+    def _release(self, rid):
         with self._lock:
-            self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
+            if rid in self._inflight:
+                self._inflight[rid] = max(0, self._inflight[rid] - 1)
 
     def remote(self, *args, **kwargs):
         """Async submit; returns an ObjectRef."""
@@ -130,8 +160,9 @@ class DeploymentHandle:
         return _Method()
 
     def refresh_if_stale(self):
-        """Kept for API compatibility; push invalidation makes explicit
-        calls unnecessary."""
+        """Refresh only when the push marked us stale — NO per-request
+        controller RPC (that hop is what push-invalidation removes; missed
+        pushes are healed by _pick_replica's PULL_FALLBACK_S timer)."""
         if self._stale.is_set():
             try:
                 self._refresh()

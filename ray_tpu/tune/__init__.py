@@ -1,4 +1,10 @@
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (  # noqa: F401
     choice,
     grid_search,

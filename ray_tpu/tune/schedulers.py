@@ -1,18 +1,24 @@
-"""Trial schedulers: FIFO and ASHA.
+"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, PBT.
 
 Analog of the reference's tune.schedulers (reference:
 python/ray/tune/schedulers/async_hyperband.py AsyncHyperBandScheduler —
-rung-based asynchronous successive halving; trial_scheduler.py FIFO).
+rung-based asynchronous successive halving; hyperband.py HyperBand
+brackets; median_stopping_rule.py; pbt.py PopulationBasedTraining;
+trial_scheduler.py FIFO).
 """
 
 from __future__ import annotations
 
 import math
+import random
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# PBT: ("EXPLOIT", source_trial_id, mutated_config) — the runner restarts
+# the trial from the source's checkpoint with the new config
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
@@ -68,3 +74,163 @@ class ASHAScheduler:
                 if score < cutoff:
                     return STOP
         return CONTINUE
+
+
+class HyperBandScheduler:
+    """HyperBand: several successive-halving brackets with different
+    grace periods, so no single early-stopping rate is assumed (reference:
+    tune/schedulers/hyperband.py).  Trials round-robin across brackets;
+    each bracket is an independent ASHA ladder."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 81,
+        reduction_factor: int = 3,
+    ):
+        self.brackets: List[ASHAScheduler] = []
+        s_max = int(math.log(max_t, reduction_factor))
+        for s in range(s_max + 1):
+            grace = max(1, max_t // (reduction_factor ** s))
+            self.brackets.append(
+                ASHAScheduler(
+                    metric=metric,
+                    mode=mode,
+                    grace_period=grace,
+                    reduction_factor=reduction_factor,
+                    max_t=max_t,
+                )
+            )
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._assignment[trial_id] = self._next % len(self.brackets)
+            self._next += 1
+        return self.brackets[b].on_result(trial_id, metrics)
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric is worse than the median
+    of the other trials' running averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        grace_period: int = 3,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        score = metrics.get(self.metric)
+        if score is None:
+            return CONTINUE
+        score = float(score) if self.mode == "max" else -float(score)
+        hist = self._history[trial_id]
+        hist.append(score)
+        t = len(hist)
+        if t < self.grace:
+            return CONTINUE
+        others = [
+            sum(h[:t]) / min(t, len(h))
+            for tid, h in self._history.items()
+            if tid != trial_id and h
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        # reference semantics: stop only when the trial's BEST result so
+        # far is worse than the median running average — lenient enough
+        # that healthy-but-noisy trials survive
+        best = max(hist)
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (reference: tune/schedulers/pbt.py): at every
+    perturbation_interval, a bottom-quantile trial EXPLOITs a top-quantile
+    one — the runner restores the source's checkpoint into the trial and
+    continues with a mutated copy of the source's hyperparameters."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        seed: int = 0,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        self._iters: Dict[str, int] = defaultdict(int)
+        self._scores: Dict[str, float] = {}
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self.num_exploits = 0
+
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = dict(config)
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, Domain):
+                # resample vs perturb 50/50 (reference pbt.py behavior)
+                if self._rng.random() < 0.5 or not isinstance(out.get(key), (int, float)):
+                    out[key] = spec.sample(self._rng)
+                else:
+                    out[key] = out[key] * self._rng.choice([0.8, 1.2])
+            elif isinstance(spec, (list, tuple)):
+                out[key] = self._rng.choice(list(spec))
+            elif callable(spec):
+                out[key] = spec()
+        return out
+
+    def on_result(self, trial_id: str, metrics: Dict):
+        score = metrics.get(self.metric)
+        if score is None:
+            return CONTINUE
+        score = float(score) if self.mode == "max" else -float(score)
+        self._scores[trial_id] = score
+        self._iters[trial_id] += 1
+        # population floor derived from the quantile: need at least one
+        # trial on each side of the cut
+        min_pop = max(2, math.ceil(1.0 / max(self.quantile, 1e-9)) // 2 + 1)
+        if self._iters[trial_id] % self.interval != 0 or len(self._scores) < min_pop:
+            return CONTINUE
+        # value-based quantiles (not rank membership: in a lockstep
+        # population the reporter just refreshed its score, so rank-based
+        # "am I bottom?" systematically misses ties)
+        values = sorted(self._scores.values())
+        k = max(1, int(len(values) * self.quantile))
+        bottom_cut, top_cut = values[k - 1], values[-k]
+        if score > bottom_cut:
+            return CONTINUE
+        tops = [
+            t
+            for t, s in self._scores.items()
+            if t != trial_id and s >= top_cut and s > score
+        ]
+        if not tops:
+            return CONTINUE
+        source = self._rng.choice(tops)
+        new_config = self._mutate(self._configs.get(source, {}))
+        self._configs[trial_id] = new_config
+        self.num_exploits += 1
+        return (EXPLOIT, source, new_config)

@@ -23,20 +23,29 @@ class FunctionTrainable:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def start(self, fn: Callable):
+    def start(self, fn: Callable, checkpoint=None):
         from ray_tpu.air import session as air_session
+        from ray_tpu.air.checkpoint import Checkpoint
 
+        # AIR convention (matching the train path, backend_executor.py):
+        # session.get_checkpoint() yields a Checkpoint, not a raw dict
+        if isinstance(checkpoint, dict):
+            checkpoint = Checkpoint.from_dict(checkpoint)
         trainable_self = self
 
         class _TrialSession:
             world_rank = 0
             world_size = 1
             local_rank = 0
-            loaded_checkpoint = None
+            loaded_checkpoint = checkpoint  # PBT exploit / resume path
             trial_name = self.trial_id
 
             def report(self, metrics, checkpoint=None):
-                trainable_self._queue.put(("report", (dict(metrics), None)))
+                ckpt_data = None
+                if checkpoint is not None:
+                    to_dict = getattr(checkpoint, "to_dict", None)
+                    ckpt_data = to_dict() if to_dict else checkpoint
+                trainable_self._queue.put(("report", (dict(metrics), ckpt_data)))
                 if trainable_self._stop.is_set():
                     raise _TrialStopped()
 

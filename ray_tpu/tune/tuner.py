@@ -38,6 +38,7 @@ class Trial:
     actor: Any = None
     last_metrics: Dict[str, Any] = field(default_factory=dict)
     history: List[Dict[str, Any]] = field(default_factory=list)
+    latest_checkpoint: Any = None  # dict payload from session.report
     error: Optional[str] = None
 
 
@@ -99,17 +100,27 @@ class Tuner:
         running: List[Trial] = []
         actor_cls = ray_tpu.remote(FunctionTrainable)
 
+        trial_by_id = {t.trial_id: t for t in trials}
+        if hasattr(scheduler, "on_trial_add"):
+            for t in trials:
+                scheduler.on_trial_add(t.trial_id, t.config)
+
+        def _start_trial(trial: Trial, checkpoint=None):
+            trial.actor = actor_cls.options(
+                num_cpus=self.resources_per_trial.get("CPU", 1),
+                resources={
+                    k: v for k, v in self.resources_per_trial.items() if k != "CPU"
+                },
+            ).remote(trial.trial_id, trial.config)
+            ray_tpu.get(
+                trial.actor.start.remote(self.trainable, checkpoint), timeout=120
+            )
+            trial.state = "RUNNING"
+
         while pending or running:
             while pending and len(running) < tc.max_concurrent_trials:
                 trial = pending.pop(0)
-                trial.actor = actor_cls.options(
-                    num_cpus=self.resources_per_trial.get("CPU", 1),
-                    resources={
-                        k: v for k, v in self.resources_per_trial.items() if k != "CPU"
-                    },
-                ).remote(trial.trial_id, trial.config)
-                ray_tpu.get(trial.actor.start.remote(self.trainable), timeout=120)
-                trial.state = "RUNNING"
+                _start_trial(trial)
                 running.append(trial)
 
             for trial in list(running):
@@ -117,16 +128,30 @@ class Tuner:
                     trial.actor.next_event.options(num_returns=1).remote(1.0), timeout=90
                 )
                 if kind == "report":
-                    metrics, _ckpt = payload
+                    metrics, ckpt = payload
                     metrics.setdefault("training_iteration", len(trial.history) + 1)
                     trial.history.append(metrics)
                     trial.last_metrics = metrics
+                    if ckpt is not None:
+                        trial.latest_checkpoint = ckpt
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP:
                         ray_tpu.get(trial.actor.stop.remote(), timeout=30)
                         trial.state = "STOPPED"
                         ray_tpu.kill(trial.actor)
                         running.remove(trial)
+                    elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                        # PBT: restart this trial from the source's latest
+                        # checkpoint with the mutated config (reference:
+                        # pbt.py _exploit)
+                        _, source_id, new_config = decision
+                        source = trial_by_id.get(source_id)
+                        ray_tpu.kill(trial.actor)
+                        trial.config = dict(new_config)
+                        _start_trial(
+                            trial,
+                            checkpoint=source.latest_checkpoint if source else None,
+                        )
                 elif kind == "done":
                     trial.state = "TERMINATED"
                     ray_tpu.kill(trial.actor)

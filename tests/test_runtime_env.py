@@ -1,0 +1,69 @@
+"""Runtime environments (reference tier:
+python/ray/tests/test_runtime_env*.py): env_vars, uploaded working_dir,
+py_modules through the head KV, pip/conda rejection on this image."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}})
+    def read_env():
+        return os.environ.get("RT_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "on"
+
+
+def test_py_modules_ship_code(ray_start_regular, tmp_path):
+    """A local module dir is zipped through the head KV and importable in
+    the worker (reference: _private/runtime_env/py_modules.py)."""
+    pkg = tmp_path / "shiny_mod"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 1234\n")
+    (pkg / "calc.py").write_text("def double(x):\n    return 2 * x\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_module():
+        import shiny_mod
+        from shiny_mod.calc import double
+
+        return shiny_mod.MAGIC + double(3)
+
+    assert ray_tpu.get(use_module.remote(), timeout=120) == 1240
+
+
+def test_working_dir_uploaded(ray_start_regular, tmp_path):
+    """working_dir contents travel by zip (no shared-FS assumption) and
+    the task runs chdir'ed into them (reference:
+    _private/runtime_env/working_dir.py)."""
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-77")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=120) == "payload-77"
+
+
+def test_pip_rejected_with_reason(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def nope():
+        return 1
+
+    with pytest.raises(Exception, match="package"):
+        ray_tpu.get(nope.remote(), timeout=60)
+
+
+def test_unknown_key_rejected_at_submit(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"bogus_key": 1})
+    def nope():
+        return 1
+
+    with pytest.raises(ValueError, match="bogus_key"):
+        nope.remote()

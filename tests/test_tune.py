@@ -95,3 +95,71 @@ def test_trial_error_isolated(ray_cluster):
     assert states[1] == "ERROR"
     assert states[0] == "TERMINATED" and states[2] == "TERMINATED"
     assert grid.get_best_result().config["x"] == 2
+
+
+def test_median_stopping_rule_unit():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+
+    rule = MedianStoppingRule(metric="loss", mode="min", grace_period=2, min_samples_required=2)
+    # equal-performing trials must all survive each other (best == median)
+    for step in range(1, 5):
+        for tid in ("a", "b", "c"):
+            assert rule.on_result(tid, {"loss": 0.1}) == CONTINUE
+    # an order-of-magnitude-worse trial gets cut after its grace period
+    decisions = [rule.on_result("bad", {"loss": 100.0 * s}) for s in range(1, 4)]
+    assert STOP in decisions
+
+
+def test_hyperband_brackets_stop_poor_trials():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9, reduction_factor=3)
+    # 6 trials round-robin over 3 brackets: t1 (good) and t4 (bad) share
+    # bracket 1 (grace 3); bracket 0 is the run-to-completion bracket
+    trials = {"t0": 5.0, "t1": 10.0, "t2": 7.0, "t3": 5.0, "t4": 1.0, "t5": 7.0}
+    decisions = {}
+    for t in range(1, 10):
+        for tid, base in trials.items():
+            if decisions.get(tid) == STOP:
+                continue
+            d = hb.on_result(tid, {"score": base, "training_iteration": t})
+            if d == STOP:
+                decisions[tid] = STOP
+    assert decisions.get("t4") == STOP, "poor trial never halved away"
+    assert decisions.get("t1") != STOP
+
+
+def test_pbt_exploits_and_improves(ray_start_regular):
+    """PBT end-to-end: bad-lr trials exploit good-lr trials' checkpoints
+    and mutated configs (reference: tune/schedulers/pbt.py)."""
+    from ray_tpu.air import session
+    from ray_tpu.tune import PopulationBasedTraining, TuneConfig, Tuner, choice
+
+    def train_fn(config):
+        loaded = session.get_checkpoint()
+        x = float(loaded["x"]) if loaded else 0.0
+        for step in range(12):
+            x += config["lr"]  # "progress" scales with lr
+            session.report({"score": x}, checkpoint={"x": x})
+
+    pbt = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": choice([0.01, 1.0])},
+        seed=1,
+    )
+    tuner = Tuner(
+        train_fn,
+        param_space={"lr": choice([0.01, 0.01, 0.01, 1.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=4, scheduler=pbt,
+            max_concurrent_trials=4,
+        ),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert pbt.num_exploits > 0, "PBT never exploited"
+    # exploiting the lr=1.0 trial's checkpoint should push best score well
+    # beyond what lr=0.01 alone reaches (12*0.01=0.12)
+    assert best.metrics["score"] > 1.0

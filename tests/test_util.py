@@ -104,3 +104,25 @@ def test_checkpoint_nested_directory_roundtrip(tmp_path):
     assert out["top.bin"] == b"root"
     assert out["state/meta.json"] == b"{}"
     assert out["state/layer0/w.npy"] == b"\x01\x02"
+
+
+def test_worker_logs_stream_to_driver(ray_start_regular):
+    """print() inside a task reaches the driver via the logs pubsub
+    (reference analog: _private/log_monitor.py -> driver prefix prints)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-42")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = _time.time() + 15  # tailer polls every 0.5s
+    while _time.time() < deadline:
+        if any("hello-from-worker-42" in l for l in global_worker.captured_logs):
+            break
+        _time.sleep(0.3)
+    assert any("hello-from-worker-42" in l for l in global_worker.captured_logs)
