@@ -6,6 +6,11 @@ session tmp dir and publishes via GCS pubsub; the driver prints them with
 a (pid=…) prefix).  Here a tailer thread runs inside the head process
 (and inside each raylet for its node's workers) publishing to the
 ``logs`` pubsub channel; drivers subscribe at init when log_to_driver.
+
+Known limitation vs the reference: lines are not yet scoped per job —
+pool workers serve any driver, so on a cluster with several concurrent
+drivers each sees all workers' output (the reference filters by job_id).
+Fine for the dominant one-driver-per-cluster TPU training topology.
 """
 
 from __future__ import annotations

@@ -101,17 +101,20 @@ def process_runtime_env(cw, renv: Dict[str, Any]) -> Dict[str, Any]:
         "pip",
         "conda",
         "container",
+        # derived keys: re-processing an already-processed env is a no-op
+        "working_dir_key",
+        "py_modules_keys",
     }
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
     out = dict(renv)
     wd = renv.get("working_dir")
-    if wd and os.path.exists(wd):
+    if wd and os.path.exists(wd) and "working_dir_key" not in out:
         # upload so remote nodes (no shared FS assumed) get the same tree;
         # the local path is kept as a fast path for same-node workers
         out["working_dir_key"] = _upload_package(cw, wd)
     mods = renv.get("py_modules")
-    if mods:
+    if mods and "py_modules_keys" not in out:
         keys = []
         for m in mods:
             if not os.path.exists(m):
@@ -122,46 +125,94 @@ def process_runtime_env(cw, renv: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def apply_runtime_env(cw, renv: Dict[str, Any], session_dir: str = ""):
-    """Worker-side: materialize the env before executing user code
-    (reference analog: RuntimeEnvContext.exec_worker, context.py:46 —
-    ours mutates the live process instead of re-execing)."""
+    """Worker-side: materialize the env before executing user code.
+    Returns an undo callable — pool workers are REUSED, so the sys.path
+    entries this adds must not leak into later tasks (a shipped 'utils'
+    package shadowing site-packages for an unrelated task is a silent
+    wrong-answer bug).  Reference analog: RuntimeEnvContext.exec_worker,
+    context.py:46 — theirs dedicates workers per env; ours undoes."""
     if not renv:
-        return
+        return lambda: None
     if renv.get("pip") or renv.get("conda") or renv.get("container"):
         raise RuntimeError(
             "pip/conda/container runtime envs need a package installer; this "
             "TPU-VM image is fixed and has no package egress — bake deps into "
             "the image or use py_modules for pure-python code"
         )
-    for k, v in (renv.get("env_vars") or {}).items():
-        os.environ[str(k)] = str(v)
-    stage_root = os.path.join(
-        session_dir or tempfile.gettempdir(), "runtime_env_staging"
-    )
-    for key in renv.get("py_modules_keys") or []:
-        target = _materialize(cw, key, stage_root)
-        if target not in sys.path:
-            sys.path.insert(0, target)
-    wd = renv.get("working_dir")
-    if wd:
-        if not os.path.isdir(wd) and renv.get("working_dir_key"):
-            wd = _materialize(cw, renv["working_dir_key"], stage_root, flatten=True)
-        os.chdir(wd)
-        if wd not in sys.path:
-            sys.path.insert(0, wd)
+    prev_env: Dict[str, Any] = {}
+    prev_cwd = os.getcwd()
+    added_paths: List[str] = []
+
+    def _undo():
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        for k, old in prev_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        try:
+            os.chdir(prev_cwd)
+        except OSError:
+            pass
+
+    try:
+        for k, v in (renv.get("env_vars") or {}).items():
+            k = str(k)
+            prev_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        stage_root = os.path.join(
+            session_dir or tempfile.gettempdir(), "runtime_env_staging"
+        )
+        for key in renv.get("py_modules_keys") or []:
+            target = _materialize(cw, key, stage_root)
+            if target not in sys.path:
+                sys.path.insert(0, target)
+                added_paths.append(target)
+        wd = renv.get("working_dir")
+        if wd:
+            if renv.get("working_dir_key"):
+                # ALWAYS use the uploaded snapshot: the live local dir may
+                # have mutated since submit (or hold a stale copy on another
+                # node) — every task of the job must see the same tree
+                wd = _materialize(cw, renv["working_dir_key"], stage_root, flatten=True)
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)
+                added_paths.append(wd)
+    except BaseException:
+        # a half-applied env must not leak into the reused worker's next
+        # task — exactly the bug the undo exists for
+        _undo()
+        raise
+
+    return _undo
 
 
 def _materialize(cw, key: str, stage_root: str, flatten: bool = False) -> str:
-    """Download + extract a KV package once per key (content-addressed)."""
+    """Download + extract a KV package once per key (content-addressed).
+    Concurrent workers race here: extract into a private temp dir and
+    os.rename atomically, so nobody ever imports a half-written file."""
     target = os.path.join(stage_root, key.split(":", 1)[1])
     marker = target + ".done"
     if not os.path.exists(marker):
         data = cw.kv_get(key)
         if data is None:
             raise RuntimeError(f"runtime_env package {key} missing from KV")
-        os.makedirs(target, exist_ok=True)
+        os.makedirs(stage_root, exist_ok=True)
+        tmp = tempfile.mkdtemp(prefix=".staging-", dir=stage_root)
         with zipfile.ZipFile(io.BytesIO(data)) as zf:
-            zf.extractall(target)
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            # another worker won the rename; use its copy
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
         with open(marker, "w") as f:
             f.write("ok")
     if flatten:

@@ -1,2 +1,3 @@
 from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeProvider  # noqa: F401
 from ray_tpu.autoscaler.fake_provider import FakeMultiNodeProvider  # noqa: F401
+from ray_tpu.autoscaler.tpu_vm_provider import TpuVmProvider  # noqa: F401

@@ -330,21 +330,6 @@ class CoreWorker:
             {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
         )
 
-    def _client_fetch(
-        self, oid: bytes, deadline: Optional[float]
-    ) -> Optional[SerializedObject]:
-        rem = None if deadline is None else max(0.0, deadline - time.monotonic())
-        reply = self.request(
-            MsgType.CLIENT_GET,
-            {"object_id": oid, "timeout": rem},
-            timeout=(rem + 10) if rem is not None else 3600,
-        )
-        if reply.get("state") == "timeout":
-            raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
-        if reply.get("state") == "error":
-            raise _error_from_string(reply.get("error", "object fetch failed"))
-        return SerializedObject.from_wire(reply["value"])
-
     def _promote_memory_objects(self, oids: Sequence[bytes]):
         """Make memory-store-only values (inline direct-call results)
         globally resolvable before their refs ship to another process:
@@ -362,10 +347,11 @@ class CoreWorker:
                 continue
             self._promote_memory_objects(sobj.contained)
             if self.store is None:
-                # client mode: ship the payload through the head (once)
+                # client mode: ship the payload through the head (once —
+                # marked promoted only AFTER the RPC succeeds, so a
+                # transient failure is retried on the next ship)
                 if oid in self._client_promoted:
                     continue
-                self._client_promoted.add(oid)
                 self.request(
                     MsgType.CLIENT_PUT,
                     {
@@ -374,6 +360,7 @@ class CoreWorker:
                         "contained": sobj.contained,
                     },
                 )
+                self._client_promoted.add(oid)
                 continue
             if self.store.contains(oid):
                 continue
@@ -413,6 +400,37 @@ class CoreWorker:
                 if deadline is not None:
                     rem = max(0.0, deadline - time.monotonic())
 
+                if self.store is None:
+                    # client mode: CLIENT_GET waits + pulls + returns the
+                    # payload in ONE round trip (a separate WAIT_OBJECT
+                    # first would duplicate the wait+pull server-side)
+                    async def _fetch_all():
+                        return await asyncio.gather(
+                            *[
+                                self.conn.request(
+                                    MsgType.CLIENT_GET,
+                                    {"object_id": oid, "timeout": rem},
+                                    (rem + 10) if rem is not None else 3600,
+                                )
+                                for _, oid in pending
+                            ]
+                        )
+
+                    for (i, oid), reply in zip(pending, self.io.call(_fetch_all())):
+                        state = reply.get("state")
+                        if state == "timeout":
+                            raise GetTimeoutError(
+                                f"get() timed out on {oid.hex()[:16]}"
+                            )
+                        if state == "error":
+                            raise _error_from_string(
+                                reply.get("error", "object fetch failed")
+                            )
+                        out[i] = self._materialize(
+                            SerializedObject.from_wire(reply["value"])
+                        )
+                    return out
+
                 # one concurrent WAIT_OBJECT per missing ref: each reply may
                 # embed a cross-node transfer (the head pulls the object onto
                 # OUR node before replying "sealed"), so issuing them together
@@ -436,12 +454,9 @@ class CoreWorker:
                         raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
                     if state == "error":
                         raise _error_from_string(reply.get("error", "task failed"))
-                    if self.store is None:
-                        sobj = self._client_fetch(oid, deadline)
-                    else:
-                        sobj = self.store.get_serialized(oid)
-                        if sobj is None:
-                            sobj = self._refetch_evicted(oid, deadline)
+                    sobj = self.store.get_serialized(oid)
+                    if sobj is None:
+                        sobj = self._refetch_evicted(oid, deadline)
                     out[i] = self._materialize(sobj)
             finally:
                 self._notify_blocked(False)

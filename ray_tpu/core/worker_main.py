@@ -245,10 +245,12 @@ class WorkerRuntime:
         """env_vars / working_dir / py_modules materialized in-process
         before execution (reference: _private/runtime_env/ — theirs sets
         up dedicated workers via the agent; pip/conda raise on this fixed
-        TPU-VM image, see _private/runtime_env.py)."""
+        TPU-VM image, see _private/runtime_env.py).  Returns the sys.path
+        undo so a reused pool worker doesn't leak shipped modules into
+        later tasks."""
         from ray_tpu._private.runtime_env import apply_runtime_env
 
-        apply_runtime_env(
+        return apply_runtime_env(
             self.cw,
             spec.runtime_env or {},
             session_dir=os.path.dirname(os.environ.get("RAY_TPU_STORE_PATH", "")),
@@ -256,11 +258,19 @@ class WorkerRuntime:
 
     def _execute(self, spec: TaskSpec):
         self.cw.current_task_id = spec.task_id
-        self._apply_runtime_env(spec)
-        args, kwargs = self.cw.decode_args(spec.args)
+        undo_env = self._apply_runtime_env(spec)
         if spec.task_type == NORMAL_TASK:
-            fn = self.cw.fetch_function(spec.function_id)
-            return fn(*args, **kwargs)
+            # pool workers are reused: the env (sys.path entries, env vars,
+            # cwd) must not leak into the next (unrelated) task — even when
+            # arg decode or the function fetch fails.  Actors keep theirs:
+            # the env belongs to the actor.
+            try:
+                args, kwargs = self.cw.decode_args(spec.args)
+                fn = self.cw.fetch_function(spec.function_id)
+                return fn(*args, **kwargs)
+            finally:
+                undo_env()
+        args, kwargs = self.cw.decode_args(spec.args)
         if spec.task_type == ACTOR_CREATION_TASK:
             cls = self.cw.fetch_function(spec.function_id)
             self.actor.cls = cls

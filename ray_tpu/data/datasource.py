@@ -11,7 +11,6 @@ the file boundary.
 
 from __future__ import annotations
 
-import builtins
 import os
 from typing import List, Optional, Union
 

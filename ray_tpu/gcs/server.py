@@ -358,6 +358,15 @@ class HeadServer:
             advertise = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
         node.transfer_addr = f"{advertise}:{transfer_port}"
 
+        # head node's own Prometheus scrape endpoint (raylets run their own)
+        from ray_tpu.raylet.metrics_agent import start_metrics_server
+
+        try:
+            mport = await start_metrics_server(self.head_node_id.hex(), self._store)
+            node.labels["metrics_addr"] = f"{advertise}:{mport}"
+        except Exception:
+            pass
+
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -548,6 +557,8 @@ class HeadServer:
         node = NodeInfo(nid, conn, p["resources"], p["store_path"], sched=self.sched)
         node.address = p.get("address", "")
         node.transfer_addr = p.get("transfer_addr", "")
+        if p.get("metrics_addr"):
+            node.labels["metrics_addr"] = p["metrics_addr"]
         self.nodes[nid] = node
         self._conn_kind[cid] = "raylet"
         self._conn_node[cid] = nid

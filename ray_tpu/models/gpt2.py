@@ -69,11 +69,17 @@ class GPT2Config:
     # the chunked scan would break
     loss_impl: str = "auto"
     # sequence-chunk length per fused-CE scan step; the transient logits
-    # block is [B, loss_chunk, padded_vocab] f32
-    loss_chunk: int = 128
+    # block is [B, loss_chunk, padded_vocab] f32.  0 = auto: ~4k tokens
+    # per block (bigger blocks amortize scan overhead, measured +0.4 MFU
+    # at b16; capped so large batches don't blow the transient)
+    loss_chunk: int = 0
     # GPipe microbatches per data shard when the mesh carries a pp axis
     # (bubble fraction (pp-1)/(M+pp-1))
     pp_microbatches: int = 4
+    # >0 turns every MLP into a top-1 switch MoE with this many experts
+    # (parallel/moe.py); experts shard over the ep mesh axis
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @property
     def padded_vocab(self) -> int:
@@ -159,12 +165,26 @@ class GPT2Model:
                 "qkv_b": jnp.zeros((L, 3 * E), pd),
                 "proj_w": norm(next(k), (L, E, E), proj_std),
                 "proj_b": jnp.zeros((L, E), pd),
-                "mlp_in_w": norm(next(k), (L, E, 4 * E), std),
-                "mlp_in_b": jnp.zeros((L, 4 * E), pd),
-                "mlp_out_w": norm(next(k), (L, 4 * E, E), proj_std),
-                "mlp_out_b": jnp.zeros((L, E), pd),
             },
         }
+        if cfg.moe_experts:
+            X = cfg.moe_experts
+            params["layers"].update(
+                {
+                    "router_w": norm(next(k), (L, E, X), std),
+                    "expert_in": norm(next(k), (L, X, E, 4 * E), std),
+                    "expert_out": norm(next(k), (L, X, 4 * E, E), proj_std),
+                }
+            )
+        else:
+            params["layers"].update(
+                {
+                    "mlp_in_w": norm(next(k), (L, E, 4 * E), std),
+                    "mlp_in_b": jnp.zeros((L, 4 * E), pd),
+                    "mlp_out_w": norm(next(k), (L, 4 * E, E), proj_std),
+                    "mlp_out_b": jnp.zeros((L, E), pd),
+                }
+            )
         return params
 
     def param_pspecs(self, mesh=None) -> Dict[str, Any]:
@@ -199,24 +219,39 @@ class GPT2Model:
                 k: relayer(v) for k, v in specs["layers"].items()
             }
             return specs
+        layers = {
+            "ln1_scale": P("fsdp", None),
+            "ln1_bias": P("fsdp", None),
+            "ln2_scale": P("fsdp", None),
+            "ln2_bias": P("fsdp", None),
+            "qkv_w": P("fsdp", None, "tp"),
+            "qkv_b": P("fsdp", "tp"),
+            "proj_w": P("fsdp", "tp", None),
+            "proj_b": P("fsdp", None),
+        }
+        if self.config.moe_experts:
+            # experts shard over ep on their expert dim; router replicates
+            layers.update(
+                {
+                    "router_w": P("fsdp", None, None),
+                    "expert_in": P("fsdp", "ep", None, None),
+                    "expert_out": P("fsdp", "ep", None, None),
+                }
+            )
+        else:
+            layers.update(
+                {
+                    "mlp_in_w": P("fsdp", None, "tp"),
+                    "mlp_in_b": P("fsdp", "tp"),
+                    "mlp_out_w": P("fsdp", "tp", None),
+                    "mlp_out_b": P("fsdp", None),
+                }
+            )
         return {
             "wte": P("tp", None),
             "wpe": P(None, None),
             "ln_f": {"scale": P(None), "bias": P(None)},
-            "layers": {
-                "ln1_scale": P("fsdp", None),
-                "ln1_bias": P("fsdp", None),
-                "ln2_scale": P("fsdp", None),
-                "ln2_bias": P("fsdp", None),
-                "qkv_w": P("fsdp", None, "tp"),
-                "qkv_b": P("fsdp", "tp"),
-                "proj_w": P("fsdp", "tp", None),
-                "proj_b": P("fsdp", None),
-                "mlp_in_w": P("fsdp", None, "tp"),
-                "mlp_in_b": P("fsdp", "tp"),
-                "mlp_out_w": P("fsdp", "tp", None),
-                "mlp_out_b": P("fsdp", None),
-            },
+            "layers": layers,
         }
 
     # ----------------------------------------------------------- forward
@@ -268,10 +303,70 @@ class GPT2Model:
         x = x + (attn @ layer_params["proj_w"].astype(cd) + layer_params["proj_b"].astype(cd))
 
         h = ln(x, layer_params["ln2_scale"].astype(jnp.float32), layer_params["ln2_bias"].astype(jnp.float32), "ln2_out")
-        h = h @ layer_params["mlp_in_w"].astype(cd) + layer_params["mlp_in_b"].astype(cd)
-        h = checkpoint_name(jax.nn.gelu(h), "gelu_out")
-        x = x + (h @ layer_params["mlp_out_w"].astype(cd) + layer_params["mlp_out_b"].astype(cd))
+        if cfg.moe_experts:
+            x = x + self._moe_mlp(h, layer_params, mesh).astype(cd)
+        else:
+            h = h @ layer_params["mlp_in_w"].astype(cd) + layer_params["mlp_in_b"].astype(cd)
+            h = checkpoint_name(jax.nn.gelu(h), "gelu_out")
+            x = x + (h @ layer_params["mlp_out_w"].astype(cd) + layer_params["mlp_out_b"].astype(cd))
         return x
+
+    def _moe_mlp(self, h: jax.Array, layer_params, mesh) -> jax.Array:
+        """Top-1 switch MoE MLP: tokens all-to-all to their expert's device
+        over the ep axis (parallel/moe.py).  ep==1 (or no mesh) runs the
+        identical routed compute without collectives, so single-device and
+        ep-sharded results agree at sufficient capacity."""
+        import functools as _ft
+
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import shard_map_compat
+        from ray_tpu.parallel.moe import moe_ffn
+
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S, E = h.shape
+        flat = h.reshape(B * S, E)
+        router = layer_params["router_w"].astype(cd)
+        ein = layer_params["expert_in"].astype(cd)
+        eout = layer_params["expert_out"].astype(cd)
+        fn = _ft.partial(
+            moe_ffn, axis_name="ep", capacity_factor=cfg.moe_capacity_factor
+        )
+        if mesh is None:
+            # degenerate ep group of one: same math, no collectives
+            import numpy as _np
+
+            from jax.sharding import Mesh
+
+            mesh1 = Mesh(_np.array(jax.devices()[:1]), ("ep",))
+            out = shard_map_compat(
+                fn,
+                mesh1,
+                in_specs=(P(None), P(None), P(None), P(None)),
+                out_specs=P(None),
+            )(flat, router, ein, eout)
+            return out.reshape(B, S, E)
+        if "ep" not in mesh.axis_names:
+            raise NotImplementedError(
+                "MoE needs an ep axis on the mesh (keep_unit_axes meshes "
+                "always carry one)"
+            )
+        data_axes = tuple(
+            a for a in ("dp", "fsdp", "ep") if a in mesh.axis_names and mesh.shape[a] > 1
+        )
+        out = shard_map_compat(
+            fn,
+            mesh,
+            in_specs=(
+                P(data_axes or None, None),
+                P(None, None),
+                P("ep", None, None),
+                P("ep", None, None),
+            ),
+            out_specs=P(data_axes or None, None),
+        )(flat, router, ein, eout)
+        return out.reshape(B, S, E)
 
     def _causal_attention(self, q, k, v):
         from ray_tpu.ops.attention import causal_attention
@@ -300,7 +395,9 @@ class GPT2Model:
         if cfg.remat and cfg.remat_policy == "dots":
             # dots + the splash kernel's named residuals: saving the ~25MB
             # of attention output/lse per layer avoids re-running the whole
-            # fwd attention kernel inside the backward pass
+            # fwd attention kernel inside the backward pass.  (Also saving
+            # ln/gelu outputs was measured SLOWER — their recompute is
+            # cheaper than the extra HBM round-trips.)
             policy = jax.checkpoint_policies.save_from_both_policies(
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 jax.checkpoint_policies.save_only_these_names("splash_residuals"),
@@ -378,14 +475,28 @@ class GPT2Model:
         impl = cfg.loss_impl
         if impl == "auto":
             sp = mesh is not None and mesh.shape.get("sp", 1) > 1
-            impl = "naive" if sp else "fused"
+            if sp:
+                impl = "naive"  # chunked scan can't express the sp layout
+            else:
+                # naive materializes the [B,S,V] logits (f32): faster when
+                # it fits (no bwd recompute — measured 162 vs 174 ms at
+                # b16/v5e), deadly when it doesn't.  Estimate the
+                # PER-DEVICE footprint against a 4 GiB budget.
+                shards = 1
+                if mesh is not None:
+                    for a in ("dp", "fsdp"):
+                        shards *= dict(mesh.shape).get(a, 1)
+                B, S = tokens.shape
+                f32_bytes = B * S * cfg.padded_vocab * 4 // max(1, shards)
+                impl = "naive" if f32_bytes <= (4 << 30) else "fused"
         if impl == "fused":
             from ray_tpu.ops.cross_entropy import fused_linear_cross_entropy
 
             x = self.backbone(params, tokens, mesh)
             w = params["wte"].astype(cfg.compute_dtype)
+            chunk = cfg.loss_chunk or max(128, min(512, 8192 // max(1, tokens.shape[0])))
             return fused_linear_cross_entropy(
-                x, w, targets, cfg.vocab_size, cfg.loss_chunk
+                x, w, targets, cfg.vocab_size, chunk
             )
         logits = self.apply(params, tokens, mesh).astype(jnp.float32)
         if cfg.padded_vocab != cfg.vocab_size:

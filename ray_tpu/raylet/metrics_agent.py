@@ -1,0 +1,72 @@
+"""Per-node metrics agent: a Prometheus scrape endpoint on every node.
+
+Analog of the reference's per-node reporter agent (reference:
+dashboard/modules/reporter/reporter_agent.py — psutil node stats +
+_private/metrics_agent.py:63 Prometheus export).  Each raylet (and the
+head, for its own node) serves ``/metrics`` with node CPU/memory, object
+store occupancy, and this process's ray_tpu.util.metrics registry, so a
+stock Prometheus scrape_config covers the whole cluster node-by-node.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _node_stats_text(node_id_hex: str, store=None) -> str:
+    import psutil
+
+    tags = f'{{NodeId="{node_id_hex}"}}'
+    lines = []
+
+    def emit(name, kind, value, help_text):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name}{tags} {value}")
+
+    emit("node_cpu_percent", "gauge", psutil.cpu_percent(interval=None),
+         "CPU utilization of this node (percent)")
+    vm = psutil.virtual_memory()
+    emit("node_mem_used_bytes", "gauge", vm.used, "Used node memory")
+    emit("node_mem_total_bytes", "gauge", vm.total, "Total node memory")
+    try:
+        la1, la5, la15 = __import__("os").getloadavg()
+        emit("node_load1", "gauge", la1, "1-minute load average")
+    except OSError:
+        pass
+    if store is not None:
+        emit("object_store_used_bytes", "gauge", store.used(),
+             "Bytes allocated in this node's shm object store")
+        emit("object_store_capacity_bytes", "gauge", store.capacity(),
+             "Capacity of this node's shm object store")
+        emit("object_store_num_objects", "gauge", store.num_objects(),
+             "Objects resident in this node's shm store")
+        emit("object_store_evictions_total", "counter", store.evictions(),
+             "LRU evictions since store creation")
+    return "\n".join(lines) + "\n"
+
+
+async def start_metrics_server(node_id_hex: str, store=None, port: int = 0) -> int:
+    """Serve /metrics on this node; returns the bound port."""
+    from aiohttp import web
+
+    from ray_tpu.util import metrics as metrics_mod
+
+    async def handle(_request):
+        body = _node_stats_text(node_id_hex, store)
+        try:
+            # app metrics live in the cluster KV: only reachable from a
+            # connected process (the head/raylet agent itself isn't a
+            # driver, so node stats alone are served there)
+            body += metrics_mod.prometheus_text()
+        except Exception:
+            pass
+        return web.Response(text=body, content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", handle)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    return site._server.sockets[0].getsockname()[1]

@@ -76,6 +76,15 @@ class Raylet:
         transfer_port = await self.object_agent.start()
         advertise = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
 
+        # per-node Prometheus scrape endpoint (reference analog:
+        # dashboard reporter_agent.py)
+        from ray_tpu.raylet.metrics_agent import start_metrics_server
+
+        try:
+            metrics_port = await start_metrics_server(self.node_id.hex(), self.store)
+        except Exception:
+            metrics_port = 0
+
         conn = await Connection.connect(self.head_host, self.head_port)
         self.conn = conn
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
@@ -88,6 +97,7 @@ class Raylet:
                 "store_path": self.store_path,
                 "address": advertise,
                 "transfer_addr": f"{advertise}:{transfer_port}",
+                "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
             },
         )
         assert reply.get("ok")

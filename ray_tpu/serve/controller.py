@@ -148,14 +148,29 @@ class ServeController:
 
         _time.sleep(1.0)  # publish propagation grace
         deadline = _time.time() + 30.0
-        while _time.time() < deadline:
-            try:
-                stats = ray_tpu.get([r.stats.remote() for r in old], timeout=10)
-            except Exception:
-                break  # old replicas already dying; just kill
-            if all(s["inflight"] == 0 for s in stats):
-                break
-            _time.sleep(0.5)
+        draining = list(old)
+        from ray_tpu.exceptions import GetTimeoutError
+
+        while draining and _time.time() < deadline:
+            # submit all probes first so the waits overlap; judge each
+            # per-replica: one crashed replica must not abort the drain for
+            # the healthy ones, and a TIMEOUT means busy (a long handler
+            # blocks stats) — exactly who needs the drain
+            refs = [(r, r.stats.remote()) for r in draining]
+            still = []
+            for r, ref in refs:
+                try:
+                    s = ray_tpu.get(ref, timeout=10)
+                except GetTimeoutError:
+                    still.append(r)
+                    continue
+                except Exception:
+                    continue  # actor dead: nothing to drain
+                if s["inflight"] > 0:
+                    still.append(r)
+            draining = still
+            if draining:
+                _time.sleep(0.5)
         for victim in old:
             try:
                 ray_tpu.kill(victim)

@@ -89,8 +89,14 @@ class HyperBandScheduler:
         max_t: int = 81,
         reduction_factor: int = 3,
     ):
+        if reduction_factor < 2:
+            raise ValueError("HyperBand needs reduction_factor >= 2")
         self.brackets: List[ASHAScheduler] = []
-        s_max = int(math.log(max_t, reduction_factor))
+        # integer loop, not int(log(...)): float error at exact powers
+        # (log(243,3)=4.9999…) would silently drop the grace=1 bracket
+        s_max = 0
+        while reduction_factor ** (s_max + 1) <= max_t:
+            s_max += 1
         for s in range(s_max + 1):
             grace = max(1, max_t // (reduction_factor ** s))
             self.brackets.append(

@@ -52,3 +52,55 @@ def test_no_scale_when_idle(cluster):
         assert provider.non_terminated_nodes() == []
     finally:
         provider.shutdown()
+
+
+def test_tpu_vm_provider_gcloud_commands():
+    """TpuVmProvider drives gcloud tpu-vm create/ssh/delete/list with the
+    right arguments (runner injected — no cloud in CI; reference analog:
+    autoscaler/_private/gcp/node_provider.py)."""
+    import json as _json
+
+    from ray_tpu.autoscaler.tpu_vm_provider import TpuVmProvider
+
+    calls = []
+
+    def fake_runner(args):
+        calls.append(args)
+        if args[3] == "list":
+            return _json.dumps(
+                [
+                    {"name": "projects/p/locations/z/nodes/ray-tpu-worker-abc", "state": "READY"},
+                    {"name": "projects/p/locations/z/nodes/other-vm", "state": "READY"},
+                ]
+            )
+        return ""
+
+    provider = TpuVmProvider(
+        "10.0.0.2:6379",
+        project="proj-1",
+        zone="us-west4-a",
+        node_types={
+            "tpu_v5e_8": {
+                "resources": {"TPU": 8},
+                "accelerator_type": "v5litepod-8",
+                "runtime_version": "v2-alpha-tpuv5-lite",
+            }
+        },
+        runner=fake_runner,
+    )
+    handle = provider.create_node("tpu_v5e_8", {"TPU": 8})
+    assert handle.startswith("us-west4-a/ray-tpu-worker-")
+    create, ssh = calls[0], calls[1]
+    assert create[:5] == ["compute", "tpus", "tpu-vm", "create", handle.split("/", 1)[1]]
+    assert "--accelerator-type=v5litepod-8" in create
+    assert any(a.startswith("--version=v2-alpha") for a in create)
+    assert ssh[3] == "ssh" and "--worker=all" in ssh
+    assert any("raylet_main" in a and "10.0.0.2:6379" in a for a in ssh)
+
+    # list filters to our labeled, prefixed, READY nodes only
+    nodes = provider.non_terminated_nodes()
+    assert nodes == ["us-west4-a/ray-tpu-worker-abc"]
+
+    provider.terminate_node(handle)
+    delete = calls[-1]
+    assert delete[3] == "delete" and "--quiet" in delete

@@ -63,3 +63,39 @@ def test_multiprocessing_pool(ray_cluster):
         assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
         r = pool.apply_async(square, (6,))
         assert r.get(timeout=60) == 36
+
+
+def test_per_node_metrics_endpoints(shutdown_only):
+    """Every node (head + raylets) serves a Prometheus /metrics endpoint
+    with node + object-store gauges (reference analog:
+    dashboard/modules/reporter/reporter_agent.py)."""
+    import time as _time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        c.add_node(num_cpus=1)
+        deadline = _time.time() + 20
+        addrs = []
+        while _time.time() < deadline:
+            addrs = [
+                n["Labels"].get("metrics_addr")
+                for n in ray_tpu.nodes()
+                if n["Labels"].get("metrics_addr")
+            ]
+            if len(addrs) >= 2:
+                break
+            _time.sleep(0.5)
+        assert len(addrs) >= 2, f"metrics endpoints missing: {ray_tpu.nodes()}"
+        for addr in addrs:
+            with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert "node_cpu_percent" in body
+            assert "object_store_capacity_bytes" in body
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

@@ -51,6 +51,32 @@ def test_working_dir_uploaded(ray_start_regular, tmp_path):
     assert ray_tpu.get(read_file.remote(), timeout=120) == "payload-77"
 
 
+def test_working_dir_upload_path_without_local_dir(ray_start_regular, tmp_path):
+    """Force the ZIP path: pre-process the env, then move the source dir
+    so the worker cannot take the local-path fast path — the task must
+    extract from the KV package (simulating a remote node)."""
+    import shutil
+
+    from ray_tpu._private.runtime_env import process_runtime_env
+    from ray_tpu._private.worker import global_worker
+
+    wd = tmp_path / "proj2"
+    wd.mkdir()
+    (wd / "data.txt").write_text("zipped-88")
+
+    cw = global_worker.core_worker
+    renv = process_runtime_env(cw, {"working_dir": str(wd)})
+    assert renv.get("working_dir_key"), "upload did not happen"
+    shutil.move(str(wd), str(tmp_path / "gone-elsewhere"))
+
+    @ray_tpu.remote(runtime_env=renv)
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=120) == "zipped-88"
+
+
 def test_pip_rejected_with_reason(ray_start_regular):
     @ray_tpu.remote(runtime_env={"pip": ["requests"]})
     def nope():
