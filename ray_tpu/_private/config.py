@@ -31,6 +31,8 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "worker_pool_min_idle": (int, 0, "prestarted idle workers per node"),
     "worker_pool_max_workers": (int, 64, "hard cap of worker processes per node"),
     "idle_worker_kill_s": (float, 300.0, "kill idle workers after this long"),
+    "memory_usage_threshold": (float, 0.95, "node memory fraction above which the OOM policy kills a retriable worker"),
+    "memory_monitor_interval_s": (float, 2.0, "OOM policy check period; 0 disables"),
     # -- objects --
     "max_direct_call_object_size": (int, 100 * 1024, "objects <= this inline in the owner store"),
     "enable_direct_actor_calls": (bool, True, "callers push actor tasks straight to the actor's worker (head only for FSM/fallback)"),

@@ -54,6 +54,10 @@ class TaskSpec:
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     # set when the worker owning this actor should claim the real TPU chip
     claim_tpu: bool = False
+    # span context when tracing is on (util/tracing.py): trace_id /
+    # parent_span_id / span_id — the reference's injected span metadata
+    # (tracing_helper.py _DictPropagator)
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

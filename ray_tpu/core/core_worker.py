@@ -59,6 +59,12 @@ _ERROR_CLASSES = {
 }
 
 
+def _new_span():
+    from ray_tpu.util.tracing import new_span_context
+
+    return new_span_context()
+
+
 def _error_from_string(msg: str) -> Exception:
     head, _, rest = msg.partition(":")
     cls = _ERROR_CLASSES.get(head.strip())
@@ -617,6 +623,7 @@ class CoreWorker:
             pg_bundle_index=pg_bundle_index,
             node_affinity=node_affinity,
             caller_id=self.worker_id.binary(),
+            trace_ctx=_new_span(),
             runtime_env=runtime_env or {},
         )
         self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
@@ -667,6 +674,7 @@ class CoreWorker:
             pg_id=pg_id,
             pg_bundle_index=pg_bundle_index,
             caller_id=self.worker_id.binary(),
+            trace_ctx=_new_span(),
             runtime_env=runtime_env or {},
         )
         self.request(MsgType.CREATE_ACTOR, {"spec": spec.to_wire()})
@@ -699,6 +707,7 @@ class CoreWorker:
             num_returns=num_returns,
             seq_no=seq,
             caller_id=self.worker_id.binary(),
+            trace_ctx=_new_span(),
         )
         conn = self._direct_conn(actor_id)
         if conn is not None:

@@ -257,7 +257,13 @@ class WorkerRuntime:
         )
 
     def _execute(self, spec: TaskSpec):
+        from ray_tpu.util.tracing import span_scope
+
         self.cw.current_task_id = spec.task_id
+        with span_scope(spec.trace_ctx):
+            return self._execute_inner(spec)
+
+    def _execute_inner(self, spec: TaskSpec):
         undo_env = self._apply_runtime_env(spec)
         if spec.task_type == NORMAL_TASK:
             # pool workers are reused: the env (sys.path entries, env vars,

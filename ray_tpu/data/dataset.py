@@ -58,6 +58,37 @@ def _block_count(block):
     return len(block)
 
 
+def _stable_hash(key) -> int:
+    """Deterministic across processes: builtin hash() is seed-randomized
+    for str/bytes, which would split one group across reduce partitions."""
+    import zlib
+
+    return zlib.crc32(repr(key).encode("utf-8", "replace"))
+
+
+@ray_tpu.remote
+def _hash_partition_block(block, key_fn, n_parts):
+    """Stage 1 of the shuffle-based groupby: split one block into n hash
+    partitions by group key, ONE RETURN PER PARTITION so each reduce task
+    pulls only its own shard (reference: _internal/push_based_shuffle.py
+    map side)."""
+    parts = [[] for _ in builtins.range(n_parts)]
+    for row in block:
+        parts[_stable_hash(key_fn(row)) % n_parts].append(row)
+    return tuple(parts) if n_parts > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _group_partition(key_fn, agg_fn, *partitions):
+    """Stage 2: all rows of one hash partition → one (key, agg) row per
+    group (reference: _internal/sort.py reduce side)."""
+    groups: Dict[Any, list] = {}
+    for rows in partitions:
+        for row in rows:
+            groups.setdefault(key_fn(row), []).append(row)
+    return [agg_fn(k, rows) for k, rows in groups.items()]
+
+
 def _to_batch(block: list, batch_format: str):
     if batch_format == "numpy":
         if block and isinstance(block[0], dict):
@@ -149,6 +180,12 @@ class Dataset:
             out.append(_concat_blocks.remote(*[refs[j] for refs in shard_refs]))
         return Dataset(out)
 
+    def groupby(self, key: Union[str, Callable]) -> "GroupedDataset":
+        """Group rows by a column name or key function (reference:
+        data/grouped_dataset.py via Dataset.groupby)."""
+        key_fn = key if callable(key) else (lambda row, _k=key: row[_k])
+        return GroupedDataset(self, key_fn)
+
     def sort(self, key: Optional[Callable] = None) -> "Dataset":
         key = key or (lambda x: x)
         rows = sorted(self.take_all(), key=key)
@@ -229,6 +266,56 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset(num_blocks={len(self._blocks)})"
+
+
+class GroupedDataset:
+    """Two-stage distributed groupby: hash-partition every block by key
+    (map tasks), then one reduce task per partition builds the per-group
+    aggregates — the push-based shuffle shape (reference:
+    data/grouped_dataset.py GroupedDataset + _internal/push_based_shuffle.py)."""
+
+    def __init__(self, ds: Dataset, key_fn: Callable):
+        self._ds = ds
+        self._key_fn = key_fn
+
+    def _run(self, agg_fn: Callable) -> Dataset:
+        n = max(1, self._ds.num_blocks())
+        # num_returns=n: partition j of every map task flows straight to
+        # reduce task j — total shuffle traffic is one pass over the data
+        part_refs = [
+            _hash_partition_block.options(num_returns=n).remote(b, self._key_fn, n)
+            for b in self._ds._blocks
+        ]
+        if n == 1:
+            part_refs = [[r] for r in part_refs]
+        out = []
+        for j in builtins.range(n):
+            out.append(
+                _group_partition.remote(
+                    self._key_fn, agg_fn, *[refs[j] for refs in part_refs]
+                )
+            )
+        return Dataset(out)
+
+    def aggregate(self, agg_fn: Callable) -> Dataset:
+        """agg_fn(key, rows) -> output row."""
+        return self._run(agg_fn)
+
+    def count(self) -> Dataset:
+        return self._run(lambda k, rows: {"key": k, "count": len(rows)})
+
+    def sum(self, column: str) -> Dataset:
+        return self._run(
+            lambda k, rows, _c=column: {"key": k, "sum": sum(r[_c] for r in rows)}
+        )
+
+    def mean(self, column: str) -> Dataset:
+        return self._run(
+            lambda k, rows, _c=column: {
+                "key": k,
+                "mean": sum(r[_c] for r in rows) / len(rows),
+            }
+        )
 
 
 class ActorPoolStrategy:

@@ -396,6 +396,7 @@ class HeadServer:
         asyncio.get_running_loop().create_task(self._idle_reaper_loop())
         asyncio.get_running_loop().create_task(self._failure_detector_loop())
         asyncio.get_running_loop().create_task(self._persist_loop())
+        asyncio.get_running_loop().create_task(self._memory_monitor_loop())
         logger.info("head server listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -1347,7 +1348,7 @@ class HeadServer:
             w.running_tasks.discard(tid)
         self.finished_task_count += 1
         if p.get("exec_end"):
-            entry_for_tl = entry or self.tasks.get(tid)
+            entry_for_tl = entry  # tid was popped above; there is no fallback
             self.timeline.append(
                 {
                     "name": (entry_for_tl.spec.function_name or entry_for_tl.spec.method_name)
@@ -1357,6 +1358,8 @@ class HeadServer:
                     "ts": p.get("exec_start", 0.0),
                     "dur": p["exec_end"] - p.get("exec_start", p["exec_end"]),
                     "error": bool(p.get("error")),
+                    # span chain when tracing is on (util/tracing.py)
+                    "trace": (entry_for_tl.spec.trace_ctx or {}) if entry_for_tl else {},
                 }
             )
         if entry is not None:
@@ -1821,7 +1824,8 @@ class HeadServer:
                     "dur": e["dur"] * 1e6,
                     "pid": e["pid"],
                     "tid": e["pid"],
-                    "args": {"error": e["error"]},
+                    "args": {"error": e["error"], **(e.get("trace") or {})},
+                    "trace": e.get("trace") or {},
                 }
             )
         return {"events": events}
@@ -2018,6 +2022,49 @@ class HeadServer:
             await self._on_worker_dead(worker.worker_id, "push failed")
 
     # ---------------------------------------------------------- maintenance
+
+    async def _memory_monitor_loop(self):
+        """OOM policy: when this host's memory crosses the threshold, kill
+        ONE worker running a retriable normal task per pass — never a
+        task's last attempt, so forward progress survives sustained
+        pressure (analog: reference raylet worker_killing_policy.cc
+        retriable-FIFO policy + memory_monitor.py:94)."""
+        interval = RayConfig.memory_monitor_interval_s
+        if interval <= 0:
+            return
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            try:
+                import psutil
+
+                usage = psutil.virtual_memory().percent / 100.0
+            except Exception:
+                continue
+            if os.environ.get("RAY_TPU_TEST_FORCE_MEMORY_PRESSURE"):
+                usage = 1.0
+            if usage < RayConfig.memory_usage_threshold:
+                continue
+            victim = None
+            for entry in self.tasks.values():
+                if (
+                    entry.state == "RUNNING"
+                    and entry.spec.task_type == NORMAL_TASK
+                    and entry.spec.retries_left > 0
+                    and entry.worker_id in self.workers
+                ):
+                    victim = self.workers[entry.worker_id]
+                    break
+            if victim is None:
+                continue
+            logger.warning(
+                "memory pressure %.0f%%: killing worker %s (task will retry)",
+                usage * 100,
+                victim.worker_id.hex()[:8],
+            )
+            try:
+                os.kill(victim.pid, 9)
+            except OSError:
+                pass
 
     async def _idle_reaper_loop(self):
         while not self._shutdown:

@@ -1,0 +1,85 @@
+"""joblib backend: run joblib.Parallel batches as ray_tpu tasks.
+
+Analog of the reference's joblib integration (reference:
+python/ray/util/joblib/__init__.py register_ray +
+ray_backend.py RayBackend over the multiprocessing Pool shim).  Usage:
+
+    from ray_tpu.util.joblib_backend import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def register_ray():
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _RayTpuBackend)
+
+
+_pool = None
+
+
+def _dispatch_pool():
+    global _pool
+    if _pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="joblib-dispatch")
+    return _pool
+
+
+try:  # joblib is in the base image; guard anyway for minimal installs
+    from joblib._parallel_backends import ThreadingBackend
+
+    class _RayTpuBackend(ThreadingBackend):
+        """Each joblib batch becomes one ray_tpu task; apply_async returns
+        immediately and the callback fires on resolution (the same shape
+        as the reference's RayBackend over its Pool)."""
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if n_jobs is None:
+                return 1  # joblib's Parallel() default
+            if n_jobs == -1:
+                try:
+                    return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+                except Exception:
+                    return 1
+            return max(1, n_jobs)
+
+        def apply_async(self, func, callback=None):
+            import ray_tpu
+            from ray_tpu._private import worker as worker_mod
+
+            @ray_tpu.remote
+            def _run_batch(f):
+                return f()
+
+            ref = _run_batch.remote(func)
+            cw = worker_mod._require_connected()
+
+            class _Future:
+                def get(self, timeout=None):
+                    return ray_tpu.get(ref, timeout=timeout)
+
+            fut = _Future()
+            if callback is not None:
+                # joblib's completion callback dispatches the NEXT batch,
+                # whose .remote() blocks on the io loop — it must never run
+                # ON the io loop (on_object_done fires there), so hop to a
+                # dedicated dispatch thread
+                cw.on_object_done(
+                    ref, lambda: _dispatch_pool().submit(callback, fut)
+                )
+            return fut
+
+except ImportError:  # pragma: no cover
+    _RayTpuBackend = None  # type: ignore[assignment]

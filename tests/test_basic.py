@@ -176,3 +176,30 @@ def test_runtime_context(ray_start_regular):
         return get_runtime_context().task_id is not None
 
     assert ray_tpu.get(inside.remote(), timeout=60)
+
+
+def test_oom_policy_kills_retriable_worker(monkeypatch, shutdown_only):
+    """Under (forced) memory pressure the head kills a worker running a
+    retriable task — never the last attempt, so the task still completes
+    (reference analog: raylet worker_killing_policy.cc retriable-FIFO)."""
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_TEST_FORCE_MEMORY_PRESSURE", "1")
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", "0.5")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_retries=1)
+    def sleepy():
+        import os as _os
+        import time as _t
+
+        _t.sleep(2.0)
+        return _os.getpid()
+
+    ref = sleepy.remote()
+    # first attempt gets OOM-killed (retries_left 1), the retry has
+    # retries_left 0 and is spared, so the call completes
+    pid = ray_tpu.get(ref, timeout=120)
+    assert pid > 0

@@ -128,3 +128,38 @@ def test_dataset_pipeline_windows(ray_start_regular):
         data.range(20, parallelism=4).window(blocks_per_window=1).iter_batches(batch_size=6)
     )
     assert sum(len(b) for b in batches) == 20
+
+
+def test_groupby_aggregations(ray_start_regular):
+    """Distributed two-stage groupby (hash partition map + reduce per
+    partition — reference: data/grouped_dataset.py)."""
+    from ray_tpu import data
+
+    rows = [{"cat": i % 3, "v": float(i)} for i in range(30)]
+    ds = data.from_items(rows, parallelism=4)
+
+    counts = {r["key"]: r["count"] for r in ds.groupby("cat").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+    sums = {r["key"]: r["sum"] for r in ds.groupby("cat").sum("v").take_all()}
+    assert sums[0] == sum(float(i) for i in range(30) if i % 3 == 0)
+
+    means = {r["key"]: r["mean"] for r in ds.groupby("cat").mean("v").take_all()}
+    expected_mean1 = sum(float(i) for i in range(30) if i % 3 == 1) / 10
+    assert abs(means[1] - expected_mean1) < 1e-9
+
+    # custom aggregate + callable key
+    out = (
+        data.from_items(rows, parallelism=4)
+        .groupby(lambda r: r["cat"] * 10)
+        .aggregate(lambda k, rs: {"k": k, "maxv": max(r["v"] for r in rs)})
+        .take_all()
+    )
+    assert {r["k"]: r["maxv"] for r in out}[20] == 29.0
+
+    # STRING keys: python's hash() is seed-randomized per worker process —
+    # the partitioner must still route equal keys to ONE reduce task
+    srows = [{"name": f"user-{i % 5}", "v": 1} for i in range(50)]
+    counted = data.from_items(srows, parallelism=5).groupby("name").count().take_all()
+    assert len(counted) == 5, f"split groups: {counted}"
+    assert all(r["count"] == 10 for r in counted)

@@ -126,3 +126,44 @@ def test_worker_logs_stream_to_driver(ray_start_regular):
             break
         _time.sleep(0.3)
     assert any("hello-from-worker-42" in l for l in global_worker.captured_logs)
+
+
+def test_joblib_backend(ray_start_regular):
+    """joblib.Parallel over ray_tpu tasks (reference analog:
+    util/joblib ray backend)."""
+    from joblib import Parallel, delayed, parallel_backend
+
+    from ray_tpu.util.joblib_backend import register_ray
+
+    register_ray()
+    with parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=4)(delayed(lambda x: x * x)(i) for i in range(20))
+    assert out == [i * i for i in range(20)]
+
+
+def test_tracing_span_chain(monkeypatch, shutdown_only):
+    """With tracing on, nested task submits share a trace id and chain
+    parent spans (reference analog: util/tracing/tracing_helper.py span
+    injection), and the timeline carries the span context."""
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    import ray_tpu
+    from ray_tpu._private.protocol import MsgType
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def inner():
+        return 1
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote(), timeout=60) == 1
+    reply = global_worker.core_worker.request(MsgType.TIMELINE, {})
+    spans = [e["trace"] for e in reply["events"] if e.get("trace")]
+    assert len(spans) >= 2, f"spans missing from timeline: {reply['events']}"
+    by_name = {e["name"]: e["trace"] for e in reply["events"] if e.get("trace")}
+    assert by_name["outer"]["trace_id"] == by_name["inner"]["trace_id"]
+    assert by_name["inner"]["parent_span_id"] == by_name["outer"]["span_id"]
