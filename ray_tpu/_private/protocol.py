@@ -100,6 +100,7 @@ class MsgType(enum.IntEnum):
     LIST_NODES = 73
     LIST_TASKS = 74
     TIMELINE = 75
+    LIST_OBJECTS = 76
 
     # errors pushed to driver
     ERROR_PUSH = 80

@@ -60,9 +60,16 @@ def _block_count(block):
 
 def _stable_hash(key) -> int:
     """Deterministic across processes: builtin hash() is seed-randomized
-    for str/bytes, which would split one group across reduce partitions."""
+    for str/bytes, which would split one group across reduce partitions.
+    Numeric keys canonicalize first so values that compare equal (1 vs
+    1.0 vs True, -0.0 vs 0.0) land in the same partition — stage 2's
+    dict grouping then merges them like the builtin hash would."""
     import zlib
 
+    if isinstance(key, bool):
+        key = int(key)
+    if isinstance(key, float) and key.is_integer():
+        key = int(key)
     return zlib.crc32(repr(key).encode("utf-8", "replace"))
 
 

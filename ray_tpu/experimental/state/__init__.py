@@ -1,6 +1,7 @@
 from ray_tpu.experimental.state.api import (  # noqa: F401
     list_actors,
     list_nodes,
+    list_objects,
     list_placement_groups,
     list_tasks,
 )

@@ -58,6 +58,23 @@ def list_nodes() -> List[dict]:
     ]
 
 
+def list_objects(limit: int = 1000) -> List[dict]:
+    """Object directory rows: state, cluster refcount, node locations,
+    spill/lineage flags (reference: state/api.py:991 list_objects)."""
+    reply = _cw().request(MsgType.LIST_OBJECTS, {"limit": limit})
+    return [
+        {
+            "object_id": o["object_id"].hex(),
+            "state": o["state"],
+            "ref_count": o["ref_count"],
+            "locations": o["locations"],
+            "spilled": o["spilled"],
+            "has_lineage": o["has_lineage"],
+        }
+        for o in reply["objects"]
+    ]
+
+
 def list_placement_groups() -> List[dict]:
     reply = _cw().request(MsgType.LIST_PGS, {})
     return [

@@ -1810,6 +1810,29 @@ class HeadServer:
                 out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
         return {"tasks": out, "finished": self.finished_task_count}
 
+    async def h_list_objects(self, cid, conn, p):
+        """Directory dump for `ray list objects` (reference analog:
+        experimental/state/api.py:991 backed by the StateAggregator)."""
+        import itertools
+
+        limit = int(p.get("limit", 1000))
+        out = []
+        # safe to islice the live dict: this handler has no awaits inside
+        # the loop, so nothing mutates the directory mid-iteration
+        for oid, entry in itertools.islice(self.objects.items(), limit):
+            spilled = self.object_spilled.get(oid)
+            out.append(
+                {
+                    "object_id": oid,
+                    "state": {PENDING: "PENDING", SEALED: "SEALED", ERRORED: "ERRORED"}[entry[0]],
+                    "ref_count": self.object_refcounts.get(oid, 0),
+                    "locations": [n.hex() for n in self.object_locations.get(oid, ())],
+                    "spilled": bool(spilled),
+                    "has_lineage": oid in self.lineage,
+                }
+            )
+        return {"objects": out, "total": len(self.objects)}
+
     async def h_timeline(self, cid, conn, p):
         """Chrome-trace events of recent task executions
         (reference: `ray timeline` scripts.py → profile table dump)."""
@@ -2051,6 +2074,9 @@ class HeadServer:
                     and entry.spec.task_type == NORMAL_TASK
                     and entry.spec.retries_left > 0
                     and entry.worker_id in self.workers
+                    # os.kill only reaches THIS host: never signal a pid
+                    # that belongs to a remote node's worker
+                    and self.workers[entry.worker_id].node_id == self.head_node_id
                 ):
                     victim = self.workers[entry.worker_id]
                     break
@@ -2109,6 +2135,7 @@ HeadServer._HANDLERS = {
     MsgType.ADD_REF: HeadServer.h_add_ref,
     MsgType.REMOVE_REF: HeadServer.h_remove_ref,
     MsgType.SPILL_NOTIFY: HeadServer.h_spill_notify,
+    MsgType.LIST_OBJECTS: HeadServer.h_list_objects,
     MsgType.CLIENT_PUT: HeadServer.h_client_put,
     MsgType.CLIENT_GET: HeadServer.h_client_get,
     MsgType.KV_PUT: HeadServer.h_kv_put,

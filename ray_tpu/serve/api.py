@@ -62,11 +62,25 @@ def _get_or_create_controller():
 
 
 def run(deployment_obj: Deployment, *, _blocking: bool = False, http_port: Optional[int] = None):
-    """Deploy and return a handle (reference: serve.run api.py:455)."""
+    """Deploy (recursively: Deployment objects in init args become live
+    handles — the deployment-graph compose of reference
+    serve/_private/deployment_graph_build.py) and return a handle
+    (reference: serve.run api.py:455)."""
     import ray_tpu
     from ray_tpu.serve.handle import DeploymentHandle
 
     controller = _get_or_create_controller()
+    # resolve nested Deployment dependencies depth-first: each becomes a
+    # DeploymentHandle passed to the parent's constructor
+    def _resolve(v):
+        if isinstance(v, Deployment):
+            return run(v)
+        return v
+
+    deployment_obj = deployment_obj.options(
+        init_args=tuple(_resolve(a) for a in deployment_obj.init_args),
+        init_kwargs={k: _resolve(v) for k, v in deployment_obj.init_kwargs.items()},
+    )
     # definition version computed HERE, where the original objects live —
     # the controller only sees deserialized copies, so identity comparison
     # there is meaningless (reference analog: deployment version strings)

@@ -21,6 +21,12 @@ import threading
 from typing import Any, Dict, List
 
 
+def _rebuild_handle(name: str) -> "DeploymentHandle":
+    from ray_tpu.serve.api import get_deployment_handle
+
+    return get_deployment_handle(name)
+
+
 class DeploymentHandle:
     # push is the fast path; this pull interval is the self-heal fallback
     # for a missed publish (failed subscribe, dropped PUBLISH RPC)
@@ -40,7 +46,10 @@ class DeploymentHandle:
         self._stale = threading.Event()
         self._last_refresh = 0.0
         self._last_refresh_attempt = 0.0
-        self._refresh()
+        # LAZY first refresh: a handle may deserialize inside the
+        # controller itself (deployment-graph args) — an eager get_handles
+        # RPC there would be the controller calling its own busy self
+        self._stale.set()
         self._subscribe_updates()
 
     def _subscribe_updates(self):
@@ -114,6 +123,16 @@ class DeploymentHandle:
                 pass  # a later request (post-backoff) retries
         with self._lock:
             n = len(self._replicas)
+        if n == 0 and self._last_refresh == 0:
+            # lazy handle that never managed a refresh: one blocking
+            # attempt so the caller sees the real error (unknown name /
+            # controller down) — still backoff-gated so a dead controller
+            # can't add a long RPC to every request
+            if _time.monotonic() - self._last_refresh_attempt > 1.0:
+                self._last_refresh_attempt = _time.monotonic()
+                self._refresh()
+        with self._lock:
+            n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(f"deployment {self._name} has no replicas")
             # round-robin, skipping replicas at their in-flight cap
@@ -158,6 +177,12 @@ class DeploymentHandle:
                 return ref
 
         return _Method()
+
+    def __reduce__(self):
+        # handles cross process boundaries (deployment-graph composition
+        # ships a dependency's handle into the parent replica's __init__):
+        # rebuild fresh in the destination, resolving the controller there
+        return (_rebuild_handle, (self._name,))
 
     def refresh_if_stale(self):
         """Refresh only when the push marked us stale — NO per-request

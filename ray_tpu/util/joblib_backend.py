@@ -22,6 +22,21 @@ def register_ray():
 
 
 _pool = None
+_run_batch_fn = None
+
+
+def _run_batch():
+    """One RemoteFunction shared by every batch (not rebuilt per dispatch)."""
+    global _run_batch_fn
+    if _run_batch_fn is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _joblib_run_batch(f):
+            return f()
+
+        _run_batch_fn = _joblib_run_batch
+    return _run_batch_fn
 
 
 def _dispatch_pool():
@@ -59,11 +74,7 @@ try:  # joblib is in the base image; guard anyway for minimal installs
             import ray_tpu
             from ray_tpu._private import worker as worker_mod
 
-            @ray_tpu.remote
-            def _run_batch(f):
-                return f()
-
-            ref = _run_batch.remote(func)
+            ref = _run_batch().remote(func)
             cw = worker_mod._require_connected()
 
             class _Future:

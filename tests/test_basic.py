@@ -190,16 +190,27 @@ def test_oom_policy_kills_retriable_worker(monkeypatch, shutdown_only):
 
     ray_tpu.init(num_cpus=2)
 
+    import tempfile
+
+    marker = tempfile.mktemp(prefix="oom_attempts_")
+
     @ray_tpu.remote(max_retries=1)
-    def sleepy():
+    def sleepy(path):
         import os as _os
         import time as _t
 
+        with open(path, "a") as f:
+            f.write("x")
         _t.sleep(2.0)
         return _os.getpid()
 
-    ref = sleepy.remote()
+    import os as _os
+
+    ref = sleepy.remote(marker)
     # first attempt gets OOM-killed (retries_left 1), the retry has
     # retries_left 0 and is spared, so the call completes
     pid = ray_tpu.get(ref, timeout=120)
     assert pid > 0
+    # the kill REALLY happened: the task body started twice
+    assert _os.path.getsize(marker) == 2, "OOM policy never killed the first attempt"
+    _os.unlink(marker)

@@ -188,3 +188,27 @@ def test_config_update_propagates_to_live_handle(ray_cluster):
         assert got == ("v1", 2)  # old generation may serve during rollout
         assert time.time() < deadline, "handle never saw the new version"
         time.sleep(0.5)
+
+
+def test_deployment_graph_composition(ray_cluster):
+    """Deployment objects in init args deploy recursively and arrive as
+    live handles (reference analog: serve deployment graphs,
+    _private/deployment_graph_build.py)."""
+
+    @serve.deployment(name="embedder")
+    def embed(x):
+        return x * 10
+
+    @serve.deployment(name="ranker")
+    class Ranker:
+        def __init__(self, embedder):
+            self.embedder = embedder  # a DeploymentHandle inside the replica
+
+        def __call__(self, x):
+            e = ray_tpu.get(self.embedder.remote(x))
+            return e + 1
+
+    handle = serve.run(Ranker.bind(embed.bind()))
+    assert ray_tpu.get(handle.remote(4), timeout=120) == 41
+    # the dependency is itself a live deployment
+    assert "embedder" in serve.list_deployments()

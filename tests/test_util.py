@@ -167,3 +167,19 @@ def test_tracing_span_chain(monkeypatch, shutdown_only):
     by_name = {e["name"]: e["trace"] for e in reply["events"] if e.get("trace")}
     assert by_name["outer"]["trace_id"] == by_name["inner"]["trace_id"]
     assert by_name["inner"]["parent_span_id"] == by_name["outer"]["span_id"]
+
+
+def test_list_objects_state_api(ray_start_regular):
+    """`ray list objects` analog (reference: state/api.py:991)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.experimental.state.api import list_objects
+
+    ref = ray_tpu.put(np.ones(1000))
+    _ = ray_tpu.get(ref, timeout=30)
+    rows = list_objects()
+    mine = [r for r in rows if r["object_id"] == ref.binary().hex()]
+    assert mine and mine[0]["state"] == "SEALED"
+    assert mine[0]["ref_count"] >= 1
+    assert mine[0]["locations"], "no location recorded"
