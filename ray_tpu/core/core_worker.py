@@ -626,7 +626,11 @@ class CoreWorker:
             trace_ctx=_new_span(),
             runtime_env=runtime_env or {},
         )
-        self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
+        # fire-and-forget on the ordered conn: queueing cannot fail in a
+        # way the caller could act on (failures seal into the return
+        # objects), and a sync round trip per submit would serialize
+        # batched submissions (reference analog: async SubmitTask)
+        self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()}))
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
 
     def create_actor(
@@ -723,7 +727,11 @@ class CoreWorker:
             ]
             self.io.spawn(self._direct_call(conn, spec, actor_id))
             return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
-        self.request(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()})
+        # fire-and-forget on the ordered conn: queueing cannot fail in a
+        # way the caller could act on (failures seal into the return
+        # objects), and a sync round trip per submit would serialize
+        # batched submissions (reference analog: async SubmitTask)
+        self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()}))
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
 
     # -------------------------------------------------- direct actor calls
