@@ -37,8 +37,11 @@ _PEAK = {
 def _bench_config():
     return {
         "model": os.environ.get("BENCH_MODEL", "gpt2_124m"),
-        "batch": int(os.environ.get("BENCH_BATCH", "16")),
-        "steps": int(os.environ.get("BENCH_STEPS", "10")),
+        # batch 18 is the sweet spot on a 16G v5e: largest batch whose
+        # [B,S,V] f32 logits still fit the naive-CE budget (no backward
+        # recompute); 30 steps measures steady state past warmup jitter
+        "batch": int(os.environ.get("BENCH_BATCH", "18")),
+        "steps": int(os.environ.get("BENCH_STEPS", "30")),
         "remat": os.environ.get("BENCH_REMAT", ""),
         "attn": os.environ.get("BENCH_ATTN", ""),
         "scores": os.environ.get("BENCH_SCORES", "bf16"),
