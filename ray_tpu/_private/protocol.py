@@ -101,6 +101,7 @@ class MsgType(enum.IntEnum):
     LIST_TASKS = 74
     TIMELINE = 75
     LIST_OBJECTS = 76
+    LIST_EVENTS = 77
 
     # errors pushed to driver
     ERROR_PUSH = 80

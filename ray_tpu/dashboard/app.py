@@ -63,6 +63,16 @@ class DashboardServer:
         async def api_timeline(request):
             return _json(ray_tpu.timeline())
 
+        async def api_events(request):
+            from ray_tpu.experimental.state.api import list_cluster_events
+
+            return _json(list_cluster_events())
+
+        async def api_objects(request):
+            from ray_tpu.experimental.state.api import list_objects
+
+            return _json(list_objects())
+
         async def index(request):
             total = ray_tpu.cluster_resources()
             avail = ray_tpu.available_resources()
@@ -88,7 +98,8 @@ class DashboardServer:
             <p>JSON: <a href=/api/cluster>cluster</a> <a href=/api/nodes>nodes</a>
             <a href=/api/actors>actors</a> <a href=/api/tasks>tasks</a>
             <a href=/api/pgs>pgs</a> <a href=/api/metrics>metrics</a>
-            <a href=/api/timeline>timeline</a></p>
+            <a href=/api/timeline>timeline</a> <a href=/api/events>events</a>
+            <a href=/api/objects>objects</a></p>
             </body></html>"""
             return web.Response(text=html, content_type="text/html")
 
@@ -101,6 +112,8 @@ class DashboardServer:
         app.router.add_get("/api/pgs", api_pgs)
         app.router.add_get("/api/metrics", api_metrics)
         app.router.add_get("/api/timeline", api_timeline)
+        app.router.add_get("/api/events", api_events)
+        app.router.add_get("/api/objects", api_objects)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", self.port)

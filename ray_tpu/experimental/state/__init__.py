@@ -1,5 +1,6 @@
 from ray_tpu.experimental.state.api import (  # noqa: F401
     list_actors,
+    list_cluster_events,
     list_nodes,
     list_objects,
     list_placement_groups,

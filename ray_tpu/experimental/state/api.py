@@ -75,6 +75,14 @@ def list_objects(limit: int = 1000) -> List[dict]:
     ]
 
 
+def list_cluster_events(limit: int = 1000) -> List[dict]:
+    """Structured lifecycle events: node/actor/worker transitions, OOM
+    kills, spill passes (reference analog: src/ray/util/event.h + the
+    dashboard event module)."""
+    reply = _cw().request(MsgType.LIST_EVENTS, {"limit": limit})
+    return reply["events"]
+
+
 def list_placement_groups() -> List[dict]:
     reply = _cw().request(MsgType.LIST_PGS, {})
     return [

@@ -296,6 +296,10 @@ class HeadServer:
         from collections import deque
 
         self.timeline: "deque" = deque(maxlen=10000)
+        # structured cluster events (analog: reference src/ray/util/event.h
+        # + dashboard event module): lifecycle transitions worth surfacing
+        # to operators, ring-buffered and queryable via LIST_EVENTS
+        self.events: "deque" = deque(maxlen=5000)
 
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
@@ -561,6 +565,7 @@ class HeadServer:
         if p.get("metrics_addr"):
             node.labels["metrics_addr"] = p["metrics_addr"]
         self.nodes[nid] = node
+        self._record_event("INFO", "node", "node registered", node_id=nid.hex())
         self._conn_kind[cid] = "raylet"
         self._conn_node[cid] = nid
         self._kick_scheduler()
@@ -667,6 +672,7 @@ class HeadServer:
             if not locs:
                 del self.object_locations[oid]
         await self._publish("node", {"event": "dead", "node_id": nid})
+        self._record_event("ERROR", "node", "node died", node_id=nid.hex())
         self._kick_scheduler()
 
     # ---------------------------------------------------- lifecycle: workers
@@ -674,7 +680,8 @@ class HeadServer:
     async def _on_worker_dead(self, wid: bytes, reason: str):
         w = self.workers.pop(wid, None)
         if w is None:
-            return
+            return  # already processed (node death then conn drop re-reports)
+        self._record_event("WARNING", "worker", f"worker died: {reason}", worker_id=wid.hex())
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.pop(wid, None)
@@ -753,6 +760,12 @@ class HeadServer:
                 actor.restarts_used,
                 actor.max_restarts,
             )
+            self._record_event(
+                "WARNING",
+                "actor",
+                f"actor restarting ({actor.restarts_used}/{actor.max_restarts})",
+                actor_id=actor.actor_id.hex(),
+            )
             await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_RESTARTING})
         else:
             await self._destroy_actor(actor, reason)
@@ -765,6 +778,7 @@ class HeadServer:
             return
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        self._record_event("ERROR", "actor", f"actor dead: {reason}", actor_id=actor.actor_id.hex())
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
         # fail queued calls
@@ -1107,6 +1121,10 @@ class HeadServer:
         return {"ok": True}
 
     def _record_spills(self, nid: bytes, spilled: Dict[bytes, str]):
+        if spilled:
+            self._record_event(
+                "INFO", "spill", f"spilled {len(spilled)} objects", node_id=nid.hex()
+            )
         for oid, path in spilled.items():
             self.object_spilled[oid] = (nid, path)
             locs = self.object_locations.get(oid)
@@ -1810,6 +1828,23 @@ class HeadServer:
                 out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
         return {"tasks": out, "finished": self.finished_task_count}
 
+    def _record_event(self, severity: str, source: str, message: str, **fields):
+        self.events.append(
+            {
+                "timestamp": time.time(),
+                "severity": severity,
+                "source": source,
+                "message": message,
+                **fields,
+            }
+        )
+
+    async def h_list_events(self, cid, conn, p):
+        limit = int(p.get("limit", 1000))
+        if limit <= 0:
+            return {"events": []}
+        return {"events": list(self.events)[-limit:]}
+
     async def h_list_objects(self, cid, conn, p):
         """Directory dump for `ray list objects` (reference analog:
         experimental/state/api.py:991 backed by the StateAggregator)."""
@@ -2087,6 +2122,12 @@ class HeadServer:
                 usage * 100,
                 victim.worker_id.hex()[:8],
             )
+            self._record_event(
+                "WARNING",
+                "oom",
+                f"memory pressure {usage:.0%}: killing retriable worker",
+                worker_id=victim.worker_id.hex(),
+            )
             try:
                 os.kill(victim.pid, 9)
             except OSError:
@@ -2136,6 +2177,7 @@ HeadServer._HANDLERS = {
     MsgType.REMOVE_REF: HeadServer.h_remove_ref,
     MsgType.SPILL_NOTIFY: HeadServer.h_spill_notify,
     MsgType.LIST_OBJECTS: HeadServer.h_list_objects,
+    MsgType.LIST_EVENTS: HeadServer.h_list_events,
     MsgType.CLIENT_PUT: HeadServer.h_client_put,
     MsgType.CLIENT_GET: HeadServer.h_client_get,
     MsgType.KV_PUT: HeadServer.h_kv_put,

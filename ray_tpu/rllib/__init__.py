@@ -1,4 +1,5 @@
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO  # noqa: F401
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401

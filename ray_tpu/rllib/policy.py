@@ -86,26 +86,7 @@ class JaxPolicy:
 
         def _update(params, opt_state, obs, actions, old_logp, advantages, returns, mask):
             def loss_fn(p):
-                # masked means: padded rows (multi-device batch rounding)
-                # carry zero weight, so padding never biases the update
-                def wmean(x):
-                    return (x * mask).sum() / mask.sum()
-
-                logits = _mlp_apply(p["pi"], obs)
-                logp_all = jax.nn.log_softmax(logits)
-                logp = logp_all[jnp.arange(obs.shape[0]), actions]
-                ratio = jnp.exp(logp - old_logp)
-                clipped = jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param)
-                pi_loss = -wmean(jnp.minimum(ratio * advantages, clipped * advantages))
-                value = _mlp_apply(p["vf"], obs)[..., 0]
-                vf_loss = wmean((value - returns) ** 2)
-                entropy = wmean(-(jnp.exp(logp_all) * logp_all).sum(-1))
-                total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
-                return total, {
-                    "policy_loss": pi_loss,
-                    "vf_loss": vf_loss,
-                    "entropy": entropy,
-                }
+                return self._ppo_loss(p, obs, actions, old_logp, advantages, returns, mask)
 
             (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state = self.optimizer.update(grads, opt_state)
@@ -138,6 +119,33 @@ class JaxPolicy:
 
         self._forward = _forward
         self._vtrace_update = None  # built lazily (IMPALA path)
+
+    def _ppo_loss(self, p, obs, actions, old_logp, advantages, returns, mask):
+        """Clipped-surrogate PPO loss, SHARED by the central learner and
+        the DDPPO grad path so the objectives can never diverge.  Masked
+        means: padded rows (multi-device batch rounding) carry zero
+        weight."""
+        import jax
+        import jax.numpy as jnp
+
+        def wmean(x):
+            return (x * mask).sum() / mask.sum()
+
+        logits = _mlp_apply(p["pi"], obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(obs.shape[0]), actions]
+        ratio = jnp.exp(logp - old_logp)
+        clipped = jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param)
+        pi_loss = -wmean(jnp.minimum(ratio * advantages, clipped * advantages))
+        value = _mlp_apply(p["vf"], obs)[..., 0]
+        vf_loss = wmean((value - returns) ** 2)
+        entropy = wmean(-(jnp.exp(logp_all) * logp_all).sum(-1))
+        total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
 
     # ------------------------------------------------------------- serving
 
@@ -259,6 +267,61 @@ class JaxPolicy:
             return params, opt_state, metrics
 
         return jax.jit(update)
+
+    def compute_grads(self, batch):
+        """PPO gradients WITHOUT applying them, flattened to one f32
+        vector — the unit a decentralized learner allreduces out-of-band
+        (reference analog: DDPPO's in-worker grad step,
+        rllib/algorithms/ddppo/ddppo.py:226)."""
+        import jax
+        import numpy as np_
+
+        from ray_tpu.rllib.sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
+
+        if not hasattr(self, "_grad_fn"):
+            import jax.numpy as jnp
+
+            from jax.flatten_util import ravel_pytree
+
+            _, unravel = ravel_pytree(self.params)
+
+            @jax.jit
+            def grad_fn(p, obs, actions, old_logp, advantages, returns):
+                mask = jnp.ones(obs.shape[0], jnp.float32)
+
+                def loss_fn(p_):
+                    total, _metrics = self._ppo_loss(
+                        p_, obs, actions, old_logp, advantages, returns, mask
+                    )
+                    return total
+
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+                flat, _ = ravel_pytree(grads)
+                return loss, flat
+
+            @jax.jit
+            def apply_fn(p, opt_state, flat):
+                grads = unravel(flat)
+                updates, opt_state = self.optimizer.update(grads, opt_state, p)
+                import optax as _optax
+
+                return _optax.apply_updates(p, updates), opt_state
+
+            self._grad_fn = grad_fn
+            self._apply_fn = apply_fn
+        loss, flat = self._grad_fn(
+            self.params,
+            batch[OBS].astype(np_.float32),
+            batch[ACTIONS].astype(np_.int32),
+            batch[LOGPS].astype(np_.float32),
+            batch[ADVANTAGES].astype(np_.float32),
+            batch[RETURNS].astype(np_.float32),
+        )
+        return np_.asarray(flat, dtype=np_.float32), {"total_loss": float(loss)}
+
+    def apply_flat_grads(self, flat):
+        """Apply a (possibly allreduced) flat gradient vector."""
+        self.params, self.opt_state = self._apply_fn(self.params, self.opt_state, flat)
 
     def get_weights(self):
         import jax

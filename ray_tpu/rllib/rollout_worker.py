@@ -47,19 +47,28 @@ def compute_gae(batch: SampleBatch, last_value: float, gamma: float, lam: float)
 class RolloutWorker:
     """Actor: owns one env (or a vector later) + a policy copy for acting."""
 
-    def __init__(self, env_creator: Callable, policy_config: Dict[str, Any], seed: int = 0):
+    def __init__(
+        self,
+        env_creator: Callable,
+        policy_config: Dict[str, Any],
+        seed: int = 0,
+        env_seed: Optional[int] = None,
+    ):
         from ray_tpu.rllib.policy import JaxPolicy
 
         self.env = env_creator()
         obs_space = self.env.observation_space
         act_space = self.env.action_space
+        # DDPPO passes the SAME policy seed to every worker (identical
+        # initial params are what keep decentralized updates in sync) with
+        # distinct env seeds for decorrelated rollouts
         self.policy = JaxPolicy(
             obs_dim=int(np.prod(obs_space.shape)),
             num_actions=int(act_space.n),
             seed=seed,
             **policy_config,
         )
-        self._obs, _ = self.env.reset(seed=seed)
+        self._obs, _ = self.env.reset(seed=env_seed if env_seed is not None else seed)
         self.gamma = policy_config.get("gamma", 0.99)  # GAE discount
         self.lam = 0.95
         self.episode_rewards = []
@@ -101,9 +110,47 @@ class RolloutWorker:
         the learner applies V-trace with the recorded behavior logps."""
         return self._rollout(num_steps)
 
+    def learn_local(
+        self,
+        num_steps: int,
+        group_name: str,
+        sgd_minibatch_size: int = 128,
+        num_sgd_iter: int = 8,
+        seed: int = 0,
+    ):
+        """DDPPO: sample locally, then run synchronized SGD — each
+        minibatch's gradients allreduce across the worker group before
+        applying, so every worker steps identically with NO central
+        learner (reference: rllib/algorithms/ddppo/ddppo.py:226,271 —
+        torch.distributed allreduce inside the rollout worker).  Every
+        worker MUST make the same number of calls per round (same
+        num_steps / minibatch config) or the collective deadlocks."""
+        import numpy as np
+
+        from ray_tpu.rllib.sample_batch import ADVANTAGES
+        from ray_tpu.util import collective
+
+        batch = self.sample(num_steps)
+        adv = batch[ADVANTAGES]
+        batch[ADVANTAGES] = (adv - adv.mean()) / max(adv.std(), 1e-6)
+        world = collective.get_collective_group_size(group_name)
+        rng = np.random.default_rng(seed)
+        metrics = {}
+        mb_size = min(sgd_minibatch_size, len(batch))
+        for _ in range(num_sgd_iter):
+            shuffled = batch.shuffle(rng)
+            for mb in shuffled.minibatches(mb_size):
+                flat, metrics = self.policy.compute_grads(mb)
+                reduced = collective.allreduce(flat, group_name=group_name) / world
+                self.policy.apply_flat_grads(reduced)
+        return {**metrics, **self.episode_stats(), "timesteps": len(batch)}
+
     def set_weights(self, weights):
         self.policy.set_weights(weights)
         return True
+
+    def get_weights(self):
+        return self.policy.get_weights()
 
     def episode_stats(self, last_n: int = 20):
         recent = self.episode_rewards[-last_n:]

@@ -183,3 +183,36 @@ def test_list_objects_state_api(ray_start_regular):
     assert mine and mine[0]["state"] == "SEALED"
     assert mine[0]["ref_count"] >= 1
     assert mine[0]["locations"], "no location recorded"
+
+
+def test_cluster_events(ray_start_regular):
+    """Lifecycle transitions land in the structured event log (reference
+    analog: util/event.h + dashboard event module)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.experimental.state.api import list_cluster_events
+
+    @ray_tpu.remote(max_restarts=1)
+    class Crashy:
+        def boom(self):
+            import os as _os
+
+            _os._exit(1)
+
+        def ok(self):
+            return 1
+
+    c = Crashy.remote()
+    assert ray_tpu.get(c.ok.remote(), timeout=60) == 1
+    c.boom.remote()
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        events = list_cluster_events()
+        kinds = {(e["source"], e["severity"]) for e in events}
+        if ("worker", "WARNING") in kinds and ("actor", "WARNING") in kinds:
+            break
+        _time.sleep(0.5)
+    sources = [e["source"] for e in list_cluster_events()]
+    assert "worker" in sources, f"no worker-death event: {sources}"
+    assert "actor" in sources, f"no actor-restart event: {sources}"
