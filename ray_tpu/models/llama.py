@@ -177,10 +177,19 @@ class LlamaModel:
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cd)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, E)
+        if kv_cache is None:
+            # train/prefill: the shared dispatch (splash pallas kernel on
+            # TPU, fused XLA elsewhere — ops/attention.py); decode keeps
+            # the masked einsum below (ragged kv lengths don't fit the
+            # block kernel)
+            from ray_tpu.ops.attention import causal_attention
+
+            attn = causal_attention(q, k, v).reshape(B, S, E)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (D**-0.5)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cd)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, E)
         x = x + attn @ lp["wo"].astype(cd)
 
         h = _rms_norm(x, lp["ffn_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
