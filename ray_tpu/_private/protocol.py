@@ -102,6 +102,7 @@ class MsgType(enum.IntEnum):
     TIMELINE = 75
     LIST_OBJECTS = 76
     LIST_EVENTS = 77
+    RECORD_EVENT = 78  # any process → head: append to the cluster-event ring
 
     # errors pushed to driver
     ERROR_PUSH = 80

@@ -123,7 +123,14 @@ class TpuVmProvider(NodeProvider):
                     "compute", "tpus", "tpu-vm", "list",
                     f"--project={self.project}",
                     f"--zone={zone}",
-                    "--filter=labels.ray-tpu-cluster=true AND state:READY",
+                    # exclusion filter: every existing VM that is not being
+                    # torn down counts — nodes still spinning up (slice
+                    # creation takes minutes) are pending capacity the
+                    # autoscaler must see or it over-provisions, and a VM
+                    # stuck in STOPPED/PREEMPTED/etc. must stay visible so
+                    # it gets reaped instead of leaking
+                    "--filter=labels.ray-tpu-cluster=true AND "
+                    "NOT state:TERMINATED AND NOT state:DELETING",
                     "--format=json",
                 ]
             )

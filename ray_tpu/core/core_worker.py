@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import os
 import threading
 import time
@@ -48,6 +49,8 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     WorkerCrashedError,
 )
+
+logger = logging.getLogger(__name__)
 
 _ERROR_CLASSES = {
     "RayActorError": RayActorError,
@@ -336,45 +339,98 @@ class CoreWorker:
             {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
         )
 
-    def _promote_memory_objects(self, oids: Sequence[bytes]):
+    def _promote_memory_objects(self, oids: Sequence[bytes], _async: bool = False):
         """Make memory-store-only values (inline direct-call results)
         globally resolvable before their refs ship to another process:
         write to the node store + seal at the head (recursing through
-        refs contained in the promoted values themselves)."""
+        refs contained in the promoted values themselves).
+
+        Refs whose producing direct call is still in flight are promoted
+        ASYNCHRONOUSLY once the reply lands (the submit carries the ref
+        immediately; any consumer blocks in the head WAIT_OBJECT until the
+        deferred seal arrives) — blocking here would serialize chained
+        actor-call pipelines and can deadlock when a sequential actor's own
+        pending result is passed to a peer.  With _async=True the head seal
+        is fire-and-forget (required on the io thread, where a blocking
+        request would deadlock the loop)."""
         for oid in oids:
             oid = bytes(oid)
             if oid in self._direct_pending:
-                # the ref's producing direct call is still in flight: its
-                # value may land inline (memory-store-only) — wait so the
-                # shipped ref is resolvable wherever it goes
-                self._resolve_direct(oid, None)
+                self._defer_promotion(oid)
+                continue
             sobj = self._memory_store.get(oid)
             if sobj is None:
                 continue
-            self._promote_memory_objects(sobj.contained)
+            self._promote_memory_objects(sobj.contained, _async=_async)
             if self.store is None:
                 # client mode: ship the payload through the head (once —
                 # marked promoted only AFTER the RPC succeeds, so a
                 # transient failure is retried on the next ship)
                 if oid in self._client_promoted:
                     continue
-                self.request(
-                    MsgType.CLIENT_PUT,
-                    {
-                        "object_id": oid,
-                        "value": sobj.to_wire(),
-                        "contained": sobj.contained,
-                    },
-                )
-                self._client_promoted.add(oid)
+                payload = {
+                    "object_id": oid,
+                    "value": sobj.to_wire(),
+                    "contained": sobj.contained,
+                }
+                if _async:
+                    self.io.spawn(
+                        self._ship_promotion(MsgType.CLIENT_PUT, payload, mark=oid)
+                    )
+                else:
+                    self.request(MsgType.CLIENT_PUT, payload)
+                    self._client_promoted.add(oid)
                 continue
             if self.store.contains(oid):
                 continue
             self.store.put_serialized(oid, sobj)
-            self.request(
-                MsgType.PUT_OBJECT,
-                {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
-            )
+            payload = {
+                "object_id": oid,
+                "node_id": self.node_id,
+                "contained": sobj.contained,
+            }
+            if _async:
+                self.io.spawn(self._ship_promotion(MsgType.PUT_OBJECT, payload))
+            else:
+                self.request(MsgType.PUT_OBJECT, payload)
+
+    async def _ship_promotion(self, msg_type, payload, mark: Optional[bytes] = None):
+        """Deferred-promotion seal RPC with retries: a consumer may already
+        be blocked in the head WAIT_OBJECT for this object, so a silently
+        dropped seal would hang it — retry transient failures and log loud
+        on final failure (the sync promotion path raises in the submitter
+        instead)."""
+        for attempt in range(3):
+            try:
+                await self.conn.request(msg_type, payload, 30)
+                if mark is not None:
+                    self._client_promoted.add(mark)
+                return
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                if attempt == 2:
+                    logger.warning(
+                        "deferred promotion seal failed for %s after 3 attempts; "
+                        "consumers of this ref may hang",
+                        bytes(payload["object_id"]).hex()[:16],
+                    )
+                    return
+                await asyncio.sleep(0.2 * (attempt + 1))
+
+    def _defer_promotion(self, oid: bytes):
+        """Promote oid when its in-flight direct call completes, holding a
+        local handle so the value can't be freed before the deferred seal."""
+        keep = ObjectRef(oid, self)
+
+        def _cb(_keep=keep):
+            # may run on the io thread (from _wake_direct): promotion must
+            # not block, hence the fire-and-forget seal path.  _keep dies
+            # with this callback (popped from _done_callbacks after firing),
+            # releasing the local handle once the promotion is in flight.
+            self._promote_memory_objects([oid], _async=True)
+
+        self.on_object_done(keep, _cb)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = time.monotonic() + timeout if timeout is not None else None
@@ -533,13 +589,58 @@ class CoreWorker:
                 direct_ids.append((i, oid))
             else:
                 pending_ids.append((i, ref.binary()))
-        if len(ready_idx) < num_returns and direct_ids:
-            # in-flight direct calls: block on the shared completion
-            # condition and recheck ALL of them each wake (per-event waits
-            # in list order would let a slow early call starve detection of
-            # an already-finished later one)
+        if len(ready_idx) < num_returns and (direct_ids or pending_ids):
+            # issue the head-side batched WAIT_OBJECT CONCURRENTLY with the
+            # direct-call condition wait: either completion wakes this
+            # waiter, so already-sealed head-path objects can satisfy
+            # num_returns while direct calls are still in flight (sequencing
+            # direct-then-head would block past ready objects — ADVICE r3)
+            head_state: Dict[str, Any] = {}
+            head_fut = None
+
+            def _on_head(f):
+                if f.cancelled():
+                    return
+                try:
+                    head_state["reply"] = f.result()
+                except BaseException as e:  # noqa: BLE001
+                    head_state["error"] = e
+                with self._direct_cv:
+                    self._direct_cv.notify_all()
+
+            def _issue_head_wait(ids, want):
+                # `want` excludes in-flight direct calls from the deficit
+                # (they satisfy num_returns without the head's help, and
+                # folding them in would withhold seals that could satisfy
+                # the caller); with no direct calls it is the full deficit,
+                # keeping the common case a single round trip.  The reply
+                # carries ALL currently-sealed ids, and the cv loop
+                # re-issues for the rest if still short.
+                rem_ = None if deadline is None else max(0.0, deadline - time.monotonic())
+                fut = self.io.spawn(
+                    self.conn.request(
+                        MsgType.WAIT_OBJECT,
+                        {
+                            "object_ids": ids,
+                            "num_ready": want,
+                            "timeout": rem_,
+                        },
+                        (rem_ + 10) if rem_ is not None else 3600,
+                    )
+                )
+                fut.add_done_callback(_on_head)
+                return fut
+
+            if pending_ids:
+                head_fut = _issue_head_wait(
+                    [oid for _, oid in pending_ids],
+                    max(1, num_returns - len(ready_idx) - len(direct_ids)),
+                )
             with self._direct_cv:
                 while True:
+                    # recheck ALL direct calls each wake (per-event waits in
+                    # list order would let a slow early call starve
+                    # detection of an already-finished later one)
                     still = []
                     for i, oid in direct_ids:
                         if oid not in self._direct_pending:
@@ -548,33 +649,80 @@ class CoreWorker:
                             ):
                                 ready_idx.add(i)
                             else:
+                                # result was stored, not inlined: it sealed
+                                # at the head; fold into the head-path set
+                                # below (a fresh probe after the loop)
                                 pending_ids.append((i, oid))
                         else:
                             still.append((i, oid))
                     direct_ids = still
-                    if not direct_ids or len(ready_idx) >= num_returns:
+                    if "reply" in head_state:
+                        sealed = {
+                            bytes(o)
+                            for o in head_state.pop("reply").get("ready", [])
+                        }
+                        head_fut = None
+                        for i, oid in pending_ids:
+                            if oid in sealed:
+                                ready_idx.add(i)
+                        pending_ids = [
+                            (i, oid) for i, oid in pending_ids if i not in ready_idx
+                        ]
+                    if len(ready_idx) >= num_returns:
                         break
+                    if "error" in head_state and not direct_ids:
+                        # only fatal when still short AND no direct call can
+                        # still help: completions that satisfy num_returns
+                        # must win over a failed head rpc (the old
+                        # sequential path never contacted the head once
+                        # satisfied, and drained directs before the head)
+                        raise head_state["error"]
                     rem = None if deadline is None else deadline - time.monotonic()
                     if rem is not None and rem <= 0:
                         break
+                    if head_fut is None and pending_ids and "error" not in head_state:
+                        # previous head wait consumed (or direct completions
+                        # moved stored results into pending): watch the rest
+                        head_fut = _issue_head_wait(
+                            [oid for _, oid in pending_ids],
+                            max(1, num_returns - len(ready_idx) - len(direct_ids)),
+                        )
+                    if not direct_ids and head_fut is None:
+                        break
                     self._direct_cv.wait(rem)
-        if len(ready_idx) < num_returns and pending_ids:
-            # remaining budget only: the direct-call wait above may have
-            # consumed part of the caller's timeout
-            rem = None if deadline is None else max(0.0, deadline - time.monotonic())
-            reply = self.request(
-                MsgType.WAIT_OBJECT,
-                {
-                    "object_ids": [oid for _, oid in pending_ids],
-                    "num_ready": num_returns - len(ready_idx),
-                    "timeout": rem,
-                },
-                timeout=(rem + 10) if rem is not None else 3600,
-            )
-            sealed = {bytes(o) for o in reply.get("ready", [])}
+            if head_fut is not None:
+                # satisfied by direct completions before the head replied:
+                # abandon the server-side wait (its late reply is ignored)
+                head_fut.cancel()
+            # direct results that were stored (not inlined) sealed at the
+            # head but may not have been covered by the concurrent batch
+            # (issued before they moved to pending_ids): probe them locally,
+            # then with a zero-timeout head probe (they are already sealed,
+            # so this never blocks)
+            late = []
             for i, oid in pending_ids:
-                if oid in sealed:
+                if i in ready_idx:
+                    continue
+                if oid in self._memory_store or (
+                    self.store is not None and self.store.contains(oid)
+                ):
                     ready_idx.add(i)
+                else:
+                    late.append((i, oid))
+            if late and len(ready_idx) < num_returns:
+                reply = self.request(
+                    MsgType.WAIT_OBJECT,
+                    {
+                        "object_ids": [oid for _, oid in late],
+                        "num_ready": len(late),
+                        "timeout": 0,
+                    },
+                    timeout=30,
+                )
+                sealed = {bytes(o) for o in reply.get("ready", [])}
+                for i, oid in late:
+                    if oid in sealed:
+                        ready_idx.add(i)
         ready, not_ready = [], []
         for i, ref in enumerate(refs):
             (ready if i in ready_idx and len(ready) < num_returns else not_ready).append(ref)
@@ -1001,6 +1149,26 @@ class CoreWorker:
         if RayConfig.object_spilling_enabled:
             self._spill_dir = store_path + ".spill"
             self.store.spill_hook = self._spill_hook
+        # pressure events from THIS claimant's allocs (workers putting task
+        # results are the common path) must reach the head's event ring too,
+        # not only allocs made in the raylet process
+        self.store.event_hook = self._store_event_hook
+
+    def _store_event_hook(self, event_type: str, payload: dict) -> None:
+        try:
+            self.io.spawn(
+                self.conn.send(
+                    MsgType.RECORD_EVENT,
+                    {
+                        "severity": "WARNING",
+                        "source": "object_store",
+                        "message": event_type,
+                        "fields": {"node_id": self.node_id, **payload},
+                    },
+                )
+            )
+        except Exception:
+            pass
 
     def _spill_hook(self, need: int) -> bool:
         """Memory pressure on our node's store: spill LRU objects to the

@@ -16,12 +16,15 @@ Object payload layout (one store object per framework object):
 from __future__ import annotations
 
 import ctypes
+import logging
 import mmap
 import os
 import struct
 from typing import List, Optional
 
 import msgpack
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu._private.build_native import ensure_lib
 from ray_tpu._private.serialization import SerializedObject
@@ -141,6 +144,10 @@ class ShmObjectStore:
         # reference analog: LocalObjectManager::SpillObjects triggered
         # before eviction of referenced data, raylet/local_object_manager.h)
         self.spill_hook = None
+        # optional (event_type, payload) callback for cluster-event
+        # reporting (wired by the raylet to the head's event ring)
+        self.event_hook = None
+        self._last_pressure_report = float("-inf")
         if create:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._handle = self._lib.store_create(path.encode(), capacity, nslots)
@@ -296,7 +303,39 @@ class ShmObjectStore:
                 made_room = False
             if not made_room:
                 break
-        # last resort: evicting alloc (out-of-scope data goes first by LRU)
+        # last resort: evicting alloc (out-of-scope data goes first by LRU).
+        # This is the outcome spill-before-evict exists to prevent — loudly
+        # record that in-scope objects may now be LRU-dropped (a put()
+        # object without lineage lost here is unrecoverable), so a slow or
+        # full spill disk under sustained pressure is diagnosable.  Rate-
+        # limited: sustained pressure means this path fires per-alloc, and
+        # an unthrottled warning+event per alloc would flood the log and
+        # the head's event ring with the very condition being reported.
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_pressure_report > 10.0:
+            self._last_pressure_report = now
+            logger.warning(
+                "shm store: spill could not make room for %d bytes after 3 "
+                "rounds (used %d/%d); falling back to LRU eviction — in-scope "
+                "objects without lineage may be lost",
+                size,
+                self.used(),
+                self.capacity(),
+            )
+            if self.event_hook is not None:
+                try:
+                    self.event_hook(
+                        "OBJECT_STORE_EVICTING_FALLBACK",
+                        {
+                            "requested": size,
+                            "used": self.used(),
+                            "capacity": self.capacity(),
+                        },
+                    )
+                except Exception:
+                    pass
         return self._lib.store_alloc(self._handle, object_id, size, off_ref)
 
     def evict_candidates(self, max_n: int = 64) -> List[tuple]:

@@ -1845,6 +1845,26 @@ class HeadServer:
             return {"events": []}
         return {"events": list(self.events)[-limit:]}
 
+    async def h_record_event(self, cid, conn, p):
+        """Remote processes (raylets, workers) append to the head's
+        cluster-event ring (reference analog: src/ray/util/event.h events
+        flowing to the dashboard event module)."""
+        # sanitize remote-controlled fields: keys must be strings and must
+        # not collide with the event envelope (severity/source/message/
+        # timestamp), or the splat raises / silently rewrites history
+        fields = {
+            str(k): v
+            for k, v in (p.get("fields") or {}).items()
+            if str(k) not in ("severity", "source", "message", "timestamp")
+        }
+        self._record_event(
+            str(p.get("severity", "INFO")),
+            str(p.get("source", "remote")),
+            str(p.get("message", "")),
+            **fields,
+        )
+        return {"ok": True}
+
     async def h_list_objects(self, cid, conn, p):
         """Directory dump for `ray list objects` (reference analog:
         experimental/state/api.py:991 backed by the StateAggregator)."""
@@ -2178,6 +2198,7 @@ HeadServer._HANDLERS = {
     MsgType.SPILL_NOTIFY: HeadServer.h_spill_notify,
     MsgType.LIST_OBJECTS: HeadServer.h_list_objects,
     MsgType.LIST_EVENTS: HeadServer.h_list_events,
+    MsgType.RECORD_EVENT: HeadServer.h_record_event,
     MsgType.CLIENT_PUT: HeadServer.h_client_put,
     MsgType.CLIENT_GET: HeadServer.h_client_get,
     MsgType.KV_PUT: HeadServer.h_kv_put,

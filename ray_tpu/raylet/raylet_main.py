@@ -72,6 +72,29 @@ class Raylet:
                 return True
 
             self.store.spill_hook = _spill_hook
+
+            def _event_hook(event_type: str, payload: dict) -> None:
+                # store pressure events surface in the head's cluster-event
+                # ring so operators can see eviction fallbacks
+                conn = getattr(self, "conn", None)
+                if conn is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        conn.send(
+                            MsgType.RECORD_EVENT,
+                            {
+                                "severity": "WARNING",
+                                "source": "object_store",
+                                "message": event_type,
+                                "fields": {
+                                    "node_id": self.node_id.hex(),
+                                    **payload,
+                                },
+                            },
+                        ),
+                        loop,
+                    )
+
+            self.store.event_hook = _event_hook
         self.object_agent = ObjectTransferAgent(self.store)
         transfer_port = await self.object_agent.start()
         advertise = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")

@@ -112,3 +112,81 @@ def test_direct_calls_error_propagates(ray_start_regular):
     assert ray_tpu.get(b.ok.remote(), timeout=60) == 1
     with pytest.raises(ValueError, match="direct boom"):
         ray_tpu.get(b.boom.remote(), timeout=30)
+
+
+def test_wait_ready_object_not_blocked_by_inflight_direct(ray_start_regular):
+    """wait(num_returns=1) over {sealed head-path ref, slow in-flight direct
+    call} must return the sealed ref promptly — the head-side WAIT_OBJECT
+    runs concurrently with the direct-call wait (ADVICE r3 medium #1)."""
+
+    @ray_tpu.remote
+    class Slow:
+        def nap(self, s):
+            time.sleep(s)
+            return "done"
+
+    s = Slow.remote()
+    assert ray_tpu.get(s.nap.remote(0), timeout=60) == "done"  # go direct
+    sealed = ray_tpu.put("ready")
+    slow_ref = s.nap.remote(5)
+    t0 = time.monotonic()
+    ready, not_ready = ray_tpu.wait([slow_ref, sealed], num_returns=1, timeout=30)
+    elapsed = time.monotonic() - t0
+    assert ready == [sealed]
+    assert not_ready == [slow_ref]
+    assert elapsed < 3.0, f"wait blocked {elapsed:.1f}s behind the in-flight direct call"
+    assert ray_tpu.get(slow_ref, timeout=60) == "done"
+
+
+def test_submit_with_inflight_direct_ref_does_not_block(ray_start_regular):
+    """Passing an in-flight direct call's ref as an argument must not turn
+    .remote() into a synchronous call (ADVICE r3 medium #2): the promotion
+    is deferred to the reply, and the consumer still sees the value."""
+
+    @ray_tpu.remote
+    class Pipe:
+        def slow_val(self, s, v):
+            time.sleep(s)
+            return v
+
+        def double(self, x):
+            return x * 2
+
+    a = Pipe.remote()
+    b = Pipe.remote()
+    # establish direct paths
+    assert ray_tpu.get(a.slow_val.remote(0, 1), timeout=60) == 1
+    assert ray_tpu.get(b.double.remote(1), timeout=60) == 2
+
+    pending = a.slow_val.remote(2, 21)  # in flight for ~2s
+    t0 = time.monotonic()
+    out = b.double.remote(pending)  # must NOT block ~2s on submit
+    submit_elapsed = time.monotonic() - t0
+    assert submit_elapsed < 1.0, f"submit blocked {submit_elapsed:.1f}s on in-flight ref"
+    assert ray_tpu.get(out, timeout=60) == 42
+
+
+def test_chained_self_ref_to_peer_no_deadlock(ray_start_regular):
+    """A sequential actor's own pending result passed to a peer used to be
+    able to deadlock the submitter; with deferred promotion the chain
+    completes."""
+
+    @ray_tpu.remote
+    class Node:
+        def produce(self, v):
+            time.sleep(0.2)
+            return v + 1
+
+        def consume(self, x):
+            return x * 10
+
+    a = Node.remote()
+    b = Node.remote()
+    assert ray_tpu.get(a.produce.remote(0), timeout=60) == 1
+    assert ray_tpu.get(b.consume.remote(1), timeout=60) == 10
+    # chain several in-flight refs through the peer without ever get()ing
+    refs = []
+    for i in range(5):
+        r = a.produce.remote(i)
+        refs.append(b.consume.remote(r))
+    assert ray_tpu.get(refs, timeout=120) == [(i + 1) * 10 for i in range(5)]
