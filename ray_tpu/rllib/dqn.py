@@ -1,0 +1,302 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Analog of the reference's DQN (reference: rllib/algorithms/dqn/dqn.py:332
+training_step — sample rollouts → store in replay buffer → sample
+minibatches → TD update → periodic target-network sync; double-DQN per
+Hasselt).  The Q-network comes from the model catalog (the "logits" head
+IS the Q-values; the value head is unused), so flat envs get the MLP and
+pixel envs the conv net, and the TD update is one jitted program.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class DQNPolicy:
+    """Q-network + target copy; epsilon-greedy acting, double-DQN TD
+    update (jitted)."""
+
+    def __init__(
+        self,
+        obs_shape,
+        num_actions: int,
+        lr: float = 1e-3,
+        gamma: float = 0.99,
+        seed: int = 0,
+        model_config: Optional[Dict[str, Any]] = None,
+        hidden=(64, 64),
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import get_model
+
+        cfg = dict(model_config or {})
+        if "hidden" not in cfg and len(tuple(obs_shape)) == 1:
+            cfg["hidden"] = hidden
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.gamma = gamma
+        self.model = get_model(self.obs_shape, num_actions, cfg)
+        self.params = self.model.init(jax.random.PRNGKey(seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._rng = np.random.default_rng(seed + 1)
+
+        @jax.jit
+        def _q_values(params, obs):
+            q, _ = self.model.apply(params, obs)
+            return q
+
+        def _update(params, target_params, opt_state, obs, actions, rewards, next_obs, dones, weights):
+            def loss_fn(p):
+                q, _ = self.model.apply(p, obs)
+                q_sa = q[jnp.arange(q.shape[0]), actions]
+                # double DQN: online net picks a', target net evaluates it
+                q_next_online, _ = self.model.apply(p, next_obs)
+                a_star = jnp.argmax(q_next_online, axis=-1)
+                q_next_target, _ = self.model.apply(target_params, next_obs)
+                q_next = q_next_target[jnp.arange(q.shape[0]), a_star]
+                target = rewards + self.gamma * (1.0 - dones) * jax.lax.stop_gradient(q_next)
+                td = q_sa - target
+                loss = (weights * optax.huber_loss(q_sa, target)).mean()
+                return loss, jnp.abs(td)
+
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._q_values = _q_values
+        self._update = jax.jit(_update)
+
+    def compute_actions(self, obs: np.ndarray, epsilon: float = 0.0):
+        q = np.asarray(self._q_values(self.params, np.asarray(obs)))
+        greedy = q.argmax(-1)
+        if epsilon > 0:
+            n = len(greedy)
+            explore = self._rng.random(n) < epsilon
+            rand = self._rng.integers(0, self.num_actions, n)
+            return np.where(explore, rand, greedy)
+        return greedy
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        obs = np.asarray(batch[OBS])
+        next_obs = np.asarray(batch[NEXT_OBS])
+        if obs.dtype != np.uint8:
+            obs = obs.astype(np.float32)
+            next_obs = next_obs.astype(np.float32)
+        weights = batch.get("weights")
+        if weights is None:
+            weights = np.ones(len(batch), np.float32)
+        self.params, self.opt_state, loss, td = self._update(
+            self.params,
+            self.target_params,
+            self.opt_state,
+            obs,
+            batch[ACTIONS].astype(np.int32),
+            batch[REWARDS].astype(np.float32),
+            next_obs,
+            np.asarray(batch[DONES], np.float32),
+            np.asarray(weights, np.float32),
+        )
+        return {"loss": float(loss), "td_error": np.asarray(td)}
+
+    def sync_target(self):
+        import jax
+
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class DQNWorker:
+    """Rollout actor for off-policy collection: epsilon-greedy stepping
+    over a VectorEnv, emitting (obs, action, reward, next_obs, done)
+    transitions (reference analog: RolloutWorker sampling into the local
+    replay actor, rllib/algorithms/dqn/dqn.py:332)."""
+
+    def __init__(self, env_creator, policy_config, seed=0, num_envs: int = 1):
+        from ray_tpu.rllib.env import make_vector_env
+
+        self.env = make_vector_env(env_creator, num_envs, seed=seed)
+        self.num_envs = self.env.num_envs
+        self.policy = DQNPolicy(
+            obs_shape=tuple(self.env.observation_space.shape),
+            num_actions=int(self.env.action_space.n),
+            seed=seed,
+            **policy_config,
+        )
+        self._obs = self.env.reset(seed=seed)
+        self.episode_rewards = []
+        self._ep_reward = np.zeros(self.num_envs, np.float64)
+
+    def sample(self, num_steps: int, epsilon: float) -> SampleBatch:
+        rows = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, DONES)}
+        for _ in range(num_steps):
+            obs = self._obs
+            actions = self.policy.compute_actions(obs, epsilon)
+            next_obs, rewards, dones, _ = self.env.step(actions)
+            rows[OBS].append(obs)
+            rows[ACTIONS].append(actions)
+            rows[REWARDS].append(rewards)
+            rows[NEXT_OBS].append(next_obs)
+            rows[DONES].append(dones)
+            self._ep_reward += rewards
+            for i in np.nonzero(dones)[0]:
+                self.episode_rewards.append(float(self._ep_reward[i]))
+                self._ep_reward[i] = 0.0
+            self._obs = next_obs
+        # flatten [T, N] -> [T*N]
+        return SampleBatch(
+            {
+                k: np.stack(v).reshape(-1, *np.asarray(v[0]).shape[1:])
+                for k, v in rows.items()
+            }
+        )
+
+    def set_weights(self, weights):
+        self.policy.set_weights(weights)
+        return True
+
+    def episode_stats(self, last_n: int = 20):
+        recent = self.episode_rewards[-last_n:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }
+
+
+@dataclass
+class DQNConfig(AlgorithmConfig):
+    buffer_size: int = 50_000
+    prioritized_replay: bool = False
+    learning_starts: int = 1_000
+    target_network_update_freq: int = 500  # env steps between target syncs
+    train_batch_size: int = 64
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.02
+    epsilon_timesteps: int = 10_000
+    num_train_per_iter: int = 32  # TD updates per train()
+    lr: float = 1e-3
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        env = config.env_creator()
+        obs_shape = tuple(env.observation_space.shape)
+        num_actions = int(env.action_space.n)
+        del env
+        policy_config = {
+            "lr": config.lr,
+            "gamma": config.gamma,
+            "model_config": config.model,
+        }
+        self.policy = DQNPolicy(
+            obs_shape=obs_shape, num_actions=num_actions, seed=config.seed, **policy_config
+        )
+        worker_cls = ray_tpu.remote(DQNWorker)
+        self.workers = [
+            worker_cls.remote(
+                config.env_creator,
+                policy_config,
+                seed=config.seed + i,
+                num_envs=config.num_envs_per_worker,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self.buffer = (
+            PrioritizedReplayBuffer(config.buffer_size, seed=config.seed)
+            if config.prioritized_replay
+            else ReplayBuffer(config.buffer_size, seed=config.seed)
+        )
+        self.total_steps = 0
+        self._steps_since_sync = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.total_steps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.time()
+        weights_ref = ray_tpu.put(self.policy.get_weights())
+        ray_tpu.get([w.set_weights.remote(weights_ref) for w in self.workers], timeout=300)
+        eps = self._epsilon()
+        per_env = max(1, -(-cfg.rollout_fragment_length // cfg.num_envs_per_worker))
+        batches = ray_tpu.get(
+            [w.sample.remote(per_env, eps) for w in self.workers], timeout=600
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self.total_steps += len(b)
+            self._steps_since_sync += len(b)
+
+        metrics: Dict[str, float] = {}
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_train_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                out = self.policy.learn_on_batch(mb)
+                if cfg.prioritized_replay:
+                    self.buffer.update_priorities(mb["batch_indexes"], out["td_error"])
+                metrics = {"loss": out["loss"]}
+                updates += 1
+            if self._steps_since_sync >= cfg.target_network_update_freq:
+                self.policy.sync_target()
+                self._steps_since_sync = 0
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers], timeout=120)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.total_steps,
+            "num_td_updates": updates,
+            "epsilon": eps,
+            "episode_reward_mean": float(
+                np.mean([s["episode_reward_mean"] for s in stats if s["episodes"] > 0] or [0.0])
+            ),
+            "episodes_total": int(sum(s["episodes"] for s in stats)),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -59,9 +59,14 @@ class _LoaderThread(threading.Thread):
                 self.device_q.put(None)
                 return
             batch, bootstrap = item
+            obs = np.asarray(batch[OBS])
+            if obs.dtype != np.uint8:
+                # pixel frames stay uint8 end-to-end (4x smaller H2D copy;
+                # the model normalizes on device)
+                obs = obs.astype(np.float32)
             staged = SampleBatch(
                 {
-                    OBS: jax.device_put(batch[OBS].astype(np.float32)),
+                    OBS: jax.device_put(obs),
                     ACTIONS: jax.device_put(batch[ACTIONS].astype(np.int32)),
                     LOGPS: jax.device_put(batch[LOGPS].astype(np.float32)),
                     REWARDS: jax.device_put(batch[REWARDS].astype(np.float32)),
@@ -111,13 +116,16 @@ class _LearnerThread(threading.Thread):
 
 
 class IMPALA(Algorithm):
+    def _extra_policy_config(self) -> Dict[str, Any]:
+        return {}
+
     def __init__(self, config: IMPALAConfig):
         super().__init__(config)
         from ray_tpu.rllib.policy import JaxPolicy
         from ray_tpu.rllib.rollout_worker import RolloutWorker
 
         env = config.env_creator()
-        obs_dim = int(np.prod(env.observation_space.shape))
+        obs_shape = tuple(env.observation_space.shape)
         num_actions = int(env.action_space.n)
         del env
         policy_config = {
@@ -125,13 +133,24 @@ class IMPALA(Algorithm):
             "clip_param": config.clip_param,
             "entropy_coeff": config.entropy_coeff,
             "gamma": config.gamma,
+            "model_config": config.model,
+            **self._extra_policy_config(),
         }
         self.policy = JaxPolicy(
-            obs_dim=obs_dim, num_actions=num_actions, seed=config.seed, **policy_config
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            seed=config.seed,
+            num_devices=config.num_learner_devices,
+            **policy_config,
         )
         worker_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
-            worker_cls.remote(config.env_creator, policy_config, seed=config.seed + i)
+            worker_cls.remote(
+                config.env_creator,
+                policy_config,
+                seed=config.seed + i,
+                num_envs=config.num_envs_per_worker,
+            )
             for i in range(config.num_rollout_workers)
         ]
         self._inflight: Dict[Any, Any] = {}  # sample ref -> worker
@@ -176,7 +195,8 @@ class IMPALA(Algorithm):
             ref = ready[0]
             w = self._inflight.pop(ref)
             batch, bootstrap = ray_tpu.get(ref, timeout=60)
-            steps += len(batch)
+            a = np.asarray(batch[ACTIONS])
+            steps += int(a.size)  # [T] or [T, N]
             self._host_q.put((batch, bootstrap))
             # async continuation: latest weights out, next fragment in
             w.set_weights.remote(self._current_weights_ref())

@@ -1,0 +1,171 @@
+"""Model catalog: pure-function policy/value networks for JaxPolicy.
+
+Analog of the reference's model catalog (reference:
+rllib/models/catalog.py — picks fcnet vs visionnet from the obs space;
+conv defaults in rllib/models/utils.py get_filter_config: the Atari
+84x84 stack [[16,[8,8],4],[32,[4,4],2],[256,[11,11],1]] and the
+torch/TF vision nets rllib/models/torch/visionnet.py).  Here each model
+is an (init, apply) pair over an explicit param pytree — apply returns
+BOTH policy logits and value in one forward so the trunk is computed
+once (the reference's shared vf_share_layers path), and conv models run
+NHWC with an optional bfloat16 compute dtype so the convolutions tile
+onto the TPU MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _dense_init(rng, fan_in: int, fan_out: int, scale: float = 2.0):
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(rng, (fan_in, fan_out)) * (scale / fan_in) ** 0.5
+    return {"w": w, "b": jnp.zeros(fan_out)}
+
+
+class MLPModel:
+    """Separate pi / vf towers (matches the original JaxPolicy layout so
+    seeded initialization is reproducible across rounds)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dim = int(np.prod(obs_shape))
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        import jax
+
+        def mlp(key, sizes):
+            params = []
+            keys = jax.random.split(key, len(sizes) - 1)
+            for k, (fi, fo) in zip(keys, zip(sizes[:-1], sizes[1:])):
+                params.append(_dense_init(k, fi, fo))
+            return params
+
+        k1, k2 = jax.random.split(rng)
+        return {
+            "pi": mlp(k1, (self.obs_dim, *self.hidden, self.num_actions)),
+            "vf": mlp(k2, (self.obs_dim, *self.hidden, 1)),
+        }
+
+    def apply(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+
+        x = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+
+        def mlp(layers, h):
+            for i, layer in enumerate(layers):
+                h = h @ layer["w"] + layer["b"]
+                if i < len(layers) - 1:
+                    h = jnp.tanh(h)
+            return h
+
+        logits = mlp(params["pi"], x)
+        value = mlp(params["vf"], x)[..., 0]
+        return logits, value
+
+
+class CNNModel:
+    """Shared conv trunk + linear pi/vf heads (nature-CNN shape).
+
+    TPU notes: NHWC activations with HWIO kernels (XLA's native TPU conv
+    layout), channel counts padded to MXU-friendly sizes by XLA, and an
+    optional bfloat16 compute dtype — params stay f32, activations run
+    bf16, logits/value are cast back to f32 for the loss."""
+
+    def __init__(
+        self,
+        obs_shape: Tuple[int, int, int],
+        num_actions: int,
+        conv_filters: Sequence[Tuple[int, int, int]] = ((32, 8, 4), (64, 4, 2), (64, 3, 1)),
+        hidden: int = 512,
+        compute_dtype: str = "float32",
+    ):
+        if len(obs_shape) != 3:
+            raise ValueError(f"CNNModel wants HWC obs, got {obs_shape}")
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.conv_filters = tuple(tuple(f) for f in conv_filters)
+        self.hidden = hidden
+        self.compute_dtype = compute_dtype
+        # conv output size (VALID padding), computed statically
+        h, w, c = obs_shape
+        for _, k, s in self.conv_filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+        self._flat = h * w * self.conv_filters[-1][0]
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(rng, len(self.conv_filters) + 3)
+        convs = []
+        c_in = self.obs_shape[-1]
+        for key, (c_out, k, _s) in zip(keys, self.conv_filters):
+            fan_in = k * k * c_in
+            kernel = jax.random.normal(key, (k, k, c_in, c_out)) * (2.0 / fan_in) ** 0.5
+            convs.append({"w": kernel, "b": jnp.zeros(c_out)})
+            c_in = c_out
+        trunk = _dense_init(keys[-3], self._flat, self.hidden)
+        # small-scale heads (standard PPO init: policy logits start ~0)
+        pi = _dense_init(keys[-2], self.hidden, self.num_actions, scale=0.02)
+        vf = _dense_init(keys[-1], self.hidden, 1, scale=1.0)
+        return {"conv": convs, "trunk": trunk, "pi": pi, "vf": vf}
+
+    def apply(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        dtype = jnp.dtype(self.compute_dtype)
+        x = obs.astype(jnp.float32)
+        if obs.dtype == jnp.uint8:
+            x = x / 255.0
+        x = x.astype(dtype)
+        for layer, (_c, _k, s) in zip(params["conv"], self.conv_filters):
+            x = lax.conv_general_dilated(
+                x,
+                layer["w"].astype(dtype),
+                window_strides=(s, s),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = jax.nn.relu(x + layer["b"].astype(dtype))
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["trunk"]["w"].astype(dtype) + params["trunk"]["b"].astype(dtype))
+        logits = (x @ params["pi"]["w"].astype(dtype) + params["pi"]["b"].astype(dtype)).astype(
+            jnp.float32
+        )
+        value = (x @ params["vf"]["w"].astype(dtype) + params["vf"]["b"].astype(dtype)).astype(
+            jnp.float32
+        )[..., 0]
+        return logits, value
+
+
+def get_model(
+    obs_shape: Tuple[int, ...],
+    num_actions: int,
+    model_config: Optional[Dict[str, Any]] = None,
+):
+    """Pick a model from the obs shape (reference analog:
+    rllib/models/catalog.py ModelCatalog.get_model_v2): rank-3 obs get the
+    conv net, flat obs the MLP.  model_config keys: type ("auto" | "mlp" |
+    "cnn"), hidden, conv_filters, compute_dtype."""
+    cfg = dict(model_config or {})
+    kind = cfg.pop("type", "auto")
+    if kind == "auto":
+        kind = "cnn" if len(obs_shape) == 3 else "mlp"
+    if kind == "cnn":
+        return CNNModel(obs_shape, num_actions, **cfg)
+    if kind == "mlp":
+        hidden = cfg.pop("hidden", (64, 64))
+        return MLPModel(obs_shape, num_actions, hidden=hidden)
+    raise ValueError(f"unknown model type {kind!r}")

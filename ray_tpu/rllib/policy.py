@@ -5,47 +5,28 @@ The reference stubs a JAX model path but never built the learner
 SURVEY §2.5); its real learners are torch towers
 (rllib/policy/torch_policy.py:60, learn_on_loaded_batch:538 splitting the
 batch across model_gpu_towers :221-230).  This is the full JAX
-realization: MLP π/V, categorical head, clipped-surrogate PPO loss, one
-jitted update — and with ``num_devices > 1`` the update is one pjit
-program over a 1-D device mesh: the batch shards across devices, params
-replicate, and XLA inserts the gradient all-reduce (the tower-stack's
-TPU-native equivalent, with the compiler doing the averaging the
-reference does in threads)."""
+realization: a model from the catalog (MLP or Atari-style CNN,
+ray_tpu/rllib/models.py) with ONE joint forward for π and V, categorical
+head, clipped-surrogate PPO loss, one jitted update — and with
+``num_devices > 1`` the update is one pjit program over a 1-D device
+mesh: the batch shards across devices, params replicate, and XLA inserts
+the gradient all-reduce (the tower-stack's TPU-native equivalent, with
+the compiler doing the averaging the reference does in threads)."""
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-
-def _mlp_init(rng, sizes):
-    import jax
-
-    params = []
-    keys = jax.random.split(rng, len(sizes) - 1)
-    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        w = jax.random.normal(k, (fan_in, fan_out)) * (2.0 / fan_in) ** 0.5
-        params.append({"w": w, "b": jax.numpy.zeros(fan_out)})
-    return params
-
-
-def _mlp_apply(params, x, final_linear=True):
-    import jax
-
-    for i, layer in enumerate(params):
-        x = x @ layer["w"] + layer["b"]
-        if i < len(params) - 1 or not final_linear:
-            x = jax.numpy.tanh(x)
-    return x
+from ray_tpu.rllib.models import get_model
 
 
 class JaxPolicy:
     def __init__(
         self,
-        obs_dim: int,
-        num_actions: int,
+        obs_dim: Optional[int] = None,
+        num_actions: int = 2,
         hidden: Tuple[int, ...] = (64, 64),
         lr: float = 3e-4,
         clip_param: float = 0.2,
@@ -54,34 +35,42 @@ class JaxPolicy:
         gamma: float = 0.99,
         seed: int = 0,
         num_devices: int = 1,
+        obs_shape: Optional[Tuple[int, ...]] = None,
+        model_config: Optional[Dict[str, Any]] = None,
+        vtrace_clip: bool = False,
     ):
         import jax
         import jax.numpy as jnp
         import optax
 
-        self.obs_dim = obs_dim
+        if obs_shape is None:
+            if obs_dim is None:
+                raise ValueError("JaxPolicy needs obs_shape or obs_dim")
+            obs_shape = (int(obs_dim),)
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dim = int(np.prod(obs_shape))
         self.num_actions = num_actions
+        cfg = dict(model_config or {})
+        if "hidden" not in cfg and len(self.obs_shape) == 1:
+            cfg["hidden"] = hidden
+        self.model = get_model(self.obs_shape, num_actions, cfg)
         rng = jax.random.PRNGKey(seed)
-        k1, k2 = jax.random.split(rng)
-        self.params = {
-            "pi": _mlp_init(k1, (obs_dim, *hidden, num_actions)),
-            "vf": _mlp_init(k2, (obs_dim, *hidden, 1)),
-        }
+        self.params = self.model.init(rng)
         self.optimizer = optax.adam(lr)
         self.opt_state = self.optimizer.init(self.params)
         self.clip_param = clip_param
         self.vf_coeff = vf_coeff
         self.entropy_coeff = entropy_coeff
         self.gamma = gamma
+        self.vtrace_clip = vtrace_clip
         self.num_devices = max(1, num_devices)
         self._rng = jax.random.PRNGKey(seed + 1)
 
         @jax.jit
         def _forward(params, obs, key):
-            logits = _mlp_apply(params["pi"], obs)
-            value = _mlp_apply(params["vf"], obs)[..., 0]
+            logits, value = self.model.apply(params, obs)
             action = jax.random.categorical(key, logits)
-            logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), action]
+            logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), action]
             return action, logp, value
 
         def _update(params, opt_state, obs, actions, old_logp, advantages, returns, mask):
@@ -96,6 +85,7 @@ class JaxPolicy:
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
+        self._update_fn = _update  # unjitted: inlined by learn_on_loaded_batch
         if self.num_devices > 1:
             # one pjit program over a 1-D mesh: batch rows shard across
             # devices (P("dp")), params/opt replicate — the mean-reductions
@@ -131,13 +121,12 @@ class JaxPolicy:
         def wmean(x):
             return (x * mask).sum() / mask.sum()
 
-        logits = _mlp_apply(p["pi"], obs)
+        logits, value = self.model.apply(p, obs)
         logp_all = jax.nn.log_softmax(logits)
-        logp = logp_all[jnp.arange(obs.shape[0]), actions]
+        logp = logp_all[jnp.arange(logits.shape[0]), actions]
         ratio = jnp.exp(logp - old_logp)
         clipped = jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param)
         pi_loss = -wmean(jnp.minimum(ratio * advantages, clipped * advantages))
-        value = _mlp_apply(p["vf"], obs)[..., 0]
         vf_loss = wmean((value - returns) ** 2)
         entropy = wmean(-(jnp.exp(logp_all) * logp_all).sum(-1))
         total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
@@ -150,19 +139,29 @@ class JaxPolicy:
     # ------------------------------------------------------------- serving
 
     def compute_actions(self, obs: np.ndarray):
+        """obs: [B, *obs_shape] — dtype passes through untouched (uint8
+        pixel frames are normalized inside the model, saving 4x on the
+        host→device transfer)."""
         import jax
 
         self._rng, key = jax.random.split(self._rng)
-        action, logp, value = self._forward(self.params, obs.astype(np.float32), key)
+        action, logp, value = self._forward(self.params, np.asarray(obs), key)
         return np.asarray(action), np.asarray(logp), np.asarray(value)
+
+    def _obs_np(self, obs):
+        obs = np.asarray(obs)
+        if obs.dtype != np.uint8:
+            obs = obs.astype(np.float32)
+        return obs.reshape(-1, *self.obs_shape)
 
     def learn_on_batch(self, batch) -> Dict[str, float]:
         from ray_tpu.rllib.sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
 
-        n = len(batch[OBS])
+        obs = self._obs_np(batch[OBS])
+        n = len(obs)
         mask = np.ones(n, np.float32)
         arrays = (
-            batch[OBS].astype(np.float32),
+            obs,
             batch[ACTIONS].astype(np.int32),
             batch[LOGPS].astype(np.float32),
             batch[ADVANTAGES].astype(np.float32),
@@ -190,24 +189,133 @@ class JaxPolicy:
         )
         return {k: float(v) for k, v in metrics.items()}
 
-    def learn_on_fragment(self, batch, bootstrap_value: float) -> Dict[str, float]:
+    def load_batch(self, batch):
+        """Stage a (GAE-postprocessed, advantage-normalized) batch onto the
+        learner's device(s) ONCE — reference analog:
+        TorchPolicy.load_batch_into_buffer (torch_policy.py:480).  Pads to
+        a multiple of num_devices; the mask zeroes padded rows."""
+        import jax
+
+        from ray_tpu.rllib.sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
+
+        obs = self._obs_np(batch[OBS])
+        n = len(obs)
+        mask = np.ones(n, np.float32)
+        arrays = (
+            obs,
+            batch[ACTIONS].astype(np.int32),
+            batch[LOGPS].astype(np.float32),
+            batch[ADVANTAGES].astype(np.float32),
+            batch[RETURNS].astype(np.float32),
+            mask,
+        )
+        if self.num_devices > 1:
+            rem = (-n) % self.num_devices
+            if rem:
+                pad_idx = np.arange(rem) % n
+                arrays = tuple(np.concatenate([a, a[pad_idx]]) for a in arrays)
+                arrays = arrays[:-1] + (
+                    np.concatenate([mask, np.zeros(rem, np.float32)]),
+                )
+            arrays = tuple(jax.device_put(a, self._batch_sharding) for a in arrays)
+        else:
+            arrays = tuple(jax.device_put(a) for a in arrays)
+        return arrays
+
+    def learn_on_loaded_batch(
+        self, staged, num_sgd_iter: int, minibatch_size: int, seed: int = 0
+    ) -> Dict[str, float]:
+        """All SGD epochs in ONE jitted program over the staged batch —
+        no host↔device traffic inside the epoch loop (reference analog:
+        TorchPolicy.learn_on_loaded_batch, torch_policy.py:538; here the
+        minibatch loop is a lax.scan over gathered row-permutations, so
+        the whole PPO inner loop is a single XLA computation)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = int(staged[0].shape[0])
+        mb = min(minibatch_size, n)
+        n_mb = max(1, n // mb)
+
+        if not hasattr(self, "_loaded_update"):
+
+            def epoch_update(params, opt_state, arrays, idx):
+                # idx: [n_iter * n_mb, mb] row indices
+                def body(carry, sel):
+                    p, o = carry
+                    mb_arrays = tuple(jnp.take(a, sel, axis=0) for a in arrays)
+                    p, o, metrics = self._update_fn(p, o, *mb_arrays)
+                    return (p, o), metrics
+
+                (params, opt_state), ms = jax.lax.scan(body, (params, opt_state), idx)
+                last = jax.tree.map(lambda x: x[-1], ms)
+                return params, opt_state, last
+
+            self._loaded_update = jax.jit(epoch_update)
+        rng = np.random.default_rng(seed + getattr(self, "_loaded_seq", 0))
+        self._loaded_seq = getattr(self, "_loaded_seq", 0) + 1
+        idx = np.stack(
+            [
+                rng.permutation(n)[: n_mb * mb].reshape(n_mb, mb)
+                for _ in range(num_sgd_iter)
+            ]
+        ).reshape(num_sgd_iter * n_mb, mb)
+        params, opt_state, metrics = self._loaded_update(
+            self.params, self.opt_state, staged, idx.astype(np.int32)
+        )
+        self.params, self.opt_state = params, opt_state
+        return {k: float(v) for k, v in metrics.items()}
+
+    def learn_on_fragment(self, batch, bootstrap_value) -> Dict[str, float]:
         """IMPALA/V-trace update on one time-ordered rollout fragment
-        (off-policy: behavior logps correct the policy lag).  Reference
-        analog: the IMPALA learner's vtrace loss consumed by
-        rllib/execution/learner_thread.py:17."""
+        (off-policy: behavior logps correct the policy lag).  Accepts
+        [T]-shaped scalar-env fragments or [T, N] vector-env fragments
+        (bootstrap scalar or [N]).  Reference analog: the IMPALA learner's
+        vtrace loss consumed by rllib/execution/learner_thread.py:17."""
         from ray_tpu.rllib.sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS
 
         if self._vtrace_update is None:
             self._vtrace_update = self._build_vtrace_update()
+        import numpy as _np
+
+        # device arrays from the loader thread stay on device: .reshape /
+        # .astype are lazy on jax arrays, while np.asarray would force a
+        # blocking D2H copy of the whole fragment (then re-upload) and
+        # defeat the IMPALA prefetch
+        obs = batch[OBS]
+        actions = batch[ACTIONS]
+        logps = batch[LOGPS]
+        rewards = batch[REWARDS]
+        dones = batch[DONES]
+        if actions.ndim == 1:
+            # scalar-env fragment: lift to [T, 1]
+            T = actions.shape[0]
+            obs = obs.reshape(T, 1, *self.obs_shape)
+            if obs.dtype != _np.uint8:
+                obs = obs.astype(_np.float32)
+            actions = actions.reshape(T, 1)
+            logps = logps.reshape(T, 1).astype(_np.float32)
+            rewards = rewards.reshape(T, 1).astype(_np.float32)
+            dones = dones.reshape(T, 1).astype(_np.float32)
+            bootstrap = _np.asarray([bootstrap_value], _np.float32)
+        else:
+            T, N = actions.shape
+            obs = obs.reshape(T, N, *self.obs_shape)
+            if obs.dtype != _np.uint8:
+                obs = obs.astype(_np.float32)
+            logps = logps.astype(_np.float32)
+            rewards = rewards.astype(_np.float32)
+            dones = dones.astype(_np.float32)
+            bootstrap = _np.asarray(bootstrap_value, _np.float32).reshape(N)
         self.params, self.opt_state, metrics = self._vtrace_update(
             self.params,
             self.opt_state,
-            batch[OBS].astype(np.float32),
-            batch[ACTIONS].astype(np.int32),
-            batch[LOGPS].astype(np.float32),
-            batch[REWARDS].astype(np.float32),
-            batch[DONES].astype(np.float32),
-            np.float32(bootstrap_value),
+            obs,
+            actions.astype(_np.int32),
+            logps,
+            rewards,
+            dones,
+            bootstrap,
         )
         return {k: float(v) for k, v in metrics.items()}
 
@@ -220,37 +328,55 @@ class JaxPolicy:
         rho_bar = c_bar = 1.0
 
         def update(params, opt_state, obs, actions, behavior_logp, rewards, dones, bootstrap):
+            # shapes: obs [T, N, *obs_shape], actions/logp/rewards/dones
+            # [T, N], bootstrap [N] — the scan runs over T with the env
+            # axis batched (vector-env fragments train in one program)
             def loss_fn(p):
-                T = obs.shape[0]
-                logits = _mlp_apply(p["pi"], obs)
+                T, N = actions.shape
+                logits, values = self.model.apply(
+                    p, obs.reshape(T * N, *self.obs_shape)
+                )
                 logp_all = jax.nn.log_softmax(logits)
-                logp = logp_all[jnp.arange(T), actions]
-                values = _mlp_apply(p["vf"], obs)[..., 0]
+                logp = logp_all[jnp.arange(T * N), actions.reshape(-1)]
+                logp = logp.reshape(T, N)
+                values = values.reshape(T, N)
 
                 rho = jnp.minimum(jnp.exp(logp - behavior_logp), rho_bar)
                 c = jnp.minimum(rho, c_bar)
                 nonterminal = 1.0 - dones
-                next_values = jnp.concatenate([values[1:], bootstrap[None]])
+                next_values = jnp.concatenate([values[1:], bootstrap[None, :]])
                 deltas = rho * (rewards + gamma * nonterminal * next_values - values)
 
                 # vs_t = V_t + delta_t + gamma*nt_t*c_t*(vs_{t+1} - V_{t+1});
-                # reverse scan carries (vs_{t+1} - V_{t+1})
+                # reverse scan carries (vs_{t+1} - V_{t+1}) per env
                 def body(carry, xs):
                     delta, c_t, nt = xs
                     acc = delta + gamma * nt * c_t * carry
                     return acc, acc
 
                 _, acc = jax.lax.scan(
-                    body, jnp.float32(0.0), (deltas, c, nonterminal), reverse=True
+                    body, jnp.zeros_like(bootstrap), (deltas, c, nonterminal), reverse=True
                 )
                 vs = values + acc
-                next_vs = jnp.concatenate([vs[1:], bootstrap[None]])
+                next_vs = jnp.concatenate([vs[1:], bootstrap[None, :]])
                 # v-trace targets are fixed targets, not differentiated
                 vs = jax.lax.stop_gradient(vs)
                 pg_adv = jax.lax.stop_gradient(
                     rho * (rewards + gamma * nonterminal * next_vs - values)
                 )
-                pi_loss = -(logp * pg_adv).mean()
+                if self.vtrace_clip:
+                    # APPO: clipped-surrogate objective on the V-trace
+                    # advantages (reference: rllib/algorithms/appo/
+                    # appo_torch_policy.py loss — PPO clip + V-trace)
+                    ratio = jnp.exp(logp - behavior_logp)
+                    clipped = jnp.clip(
+                        ratio, 1 - self.clip_param, 1 + self.clip_param
+                    )
+                    pi_loss = -jnp.minimum(
+                        ratio * pg_adv, clipped * pg_adv
+                    ).mean()
+                else:
+                    pi_loss = -(logp * pg_adv).mean()
                 vf_loss = ((values - vs) ** 2).mean()
                 entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
                 total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
@@ -287,7 +413,7 @@ class JaxPolicy:
 
             @jax.jit
             def grad_fn(p, obs, actions, old_logp, advantages, returns):
-                mask = jnp.ones(obs.shape[0], jnp.float32)
+                mask = jnp.ones(actions.shape[0], jnp.float32)
 
                 def loss_fn(p_):
                     total, _metrics = self._ppo_loss(
@@ -311,7 +437,7 @@ class JaxPolicy:
             self._apply_fn = apply_fn
         loss, flat = self._grad_fn(
             self.params,
-            batch[OBS].astype(np_.float32),
+            self._obs_np(batch[OBS]),
             batch[ACTIONS].astype(np_.int32),
             batch[LOGPS].astype(np_.float32),
             batch[ADVANTAGES].astype(np_.float32),

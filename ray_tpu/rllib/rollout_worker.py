@@ -3,7 +3,10 @@
 Analog of the reference's RolloutWorker (reference:
 rllib/evaluation/rollout_worker.py:127 init, :792 sample; GAE
 post-processing from rllib/evaluation/postprocessing.py
-compute_advantages).
+compute_advantages; vector envs rllib/env/vector_env.py:23).  One worker
+steps a VectorEnv of ``num_envs`` envs per jitted policy forward — the
+batching that makes env-steps/s a hardware number instead of a Python
+number.
 """
 
 from __future__ import annotations
@@ -25,16 +28,18 @@ from ray_tpu.rllib.sample_batch import (
 )
 
 
-def compute_gae(batch: SampleBatch, last_value: float, gamma: float, lam: float) -> SampleBatch:
-    rewards = batch[REWARDS]
-    values = batch[VALUES]
-    dones = batch[DONES]
-    n = len(rewards)
-    adv = np.zeros(n, np.float32)
-    last_gae = 0.0
-    next_value = last_value
+def compute_gae(batch: SampleBatch, last_value, gamma: float, lam: float) -> SampleBatch:
+    """GAE over [T] (scalar) or [T, N] (vector) rollouts; ``last_value``
+    is the bootstrap V of the state after the final step (scalar / [N])."""
+    rewards = np.asarray(batch[REWARDS], np.float32)
+    values = np.asarray(batch[VALUES], np.float32)
+    dones = np.asarray(batch[DONES], np.float32)
+    n = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last_gae = np.zeros_like(np.asarray(last_value, np.float32))
+    next_value = np.asarray(last_value, np.float32)
     for t in reversed(range(n)):
-        nonterminal = 1.0 - float(dones[t])
+        nonterminal = 1.0 - dones[t]
         delta = rewards[t] + gamma * next_value * nonterminal - values[t]
         last_gae = delta + gamma * lam * nonterminal * last_gae
         adv[t] = last_gae
@@ -45,7 +50,7 @@ def compute_gae(batch: SampleBatch, last_value: float, gamma: float, lam: float)
 
 
 class RolloutWorker:
-    """Actor: owns one env (or a vector later) + a policy copy for acting."""
+    """Actor: owns a VectorEnv + a policy copy for acting."""
 
     def __init__(
         self,
@@ -53,61 +58,78 @@ class RolloutWorker:
         policy_config: Dict[str, Any],
         seed: int = 0,
         env_seed: Optional[int] = None,
+        num_envs: int = 1,
     ):
+        from ray_tpu.rllib.env import make_vector_env
         from ray_tpu.rllib.policy import JaxPolicy
 
-        self.env = env_creator()
+        self.env = make_vector_env(
+            env_creator, num_envs, seed=env_seed if env_seed is not None else seed
+        )
+        self.num_envs = self.env.num_envs
         obs_space = self.env.observation_space
         act_space = self.env.action_space
         # DDPPO passes the SAME policy seed to every worker (identical
         # initial params are what keep decentralized updates in sync) with
         # distinct env seeds for decorrelated rollouts
         self.policy = JaxPolicy(
-            obs_dim=int(np.prod(obs_space.shape)),
+            obs_shape=tuple(obs_space.shape),
             num_actions=int(act_space.n),
             seed=seed,
             **policy_config,
         )
-        self._obs, _ = self.env.reset(seed=env_seed if env_seed is not None else seed)
+        self._obs = self.env.reset(seed=env_seed if env_seed is not None else seed)
         self.gamma = policy_config.get("gamma", 0.99)  # GAE discount
         self.lam = 0.95
         self.episode_rewards = []
-        self._ep_reward = 0.0
+        self._ep_reward = np.zeros(self.num_envs, np.float64)
 
     def _rollout(self, num_steps: int):
+        """num_steps PER ENV.  Returns a [T, N]-shaped batch and the [N]
+        bootstrap values (squeezed to legacy flat [T] + float when N==1)."""
+        T, N = num_steps, self.num_envs
         rows = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VALUES)}
-        for _ in range(num_steps):
-            obs = np.asarray(self._obs, np.float32).reshape(-1)
-            action, logp, value = self.policy.compute_actions(obs[None])
-            a = int(action[0])
-            next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            done = terminated or truncated
+        for _ in range(T):
+            obs = self._obs
+            action, logp, value = self.policy.compute_actions(obs)
+            next_obs, rewards, dones, _infos = self.env.step(action)
             rows[OBS].append(obs)
-            rows[ACTIONS].append(a)
-            rows[REWARDS].append(float(reward))
-            rows[DONES].append(done)
-            rows[LOGPS].append(float(logp[0]))
-            rows[VALUES].append(float(value[0]))
-            self._ep_reward += float(reward)
-            if done:
-                self.episode_rewards.append(self._ep_reward)
-                self._ep_reward = 0.0
-                self._obs, _ = self.env.reset()
-            else:
-                self._obs = next_obs
-        batch = SampleBatch({k: np.asarray(v) for k, v in rows.items()})
-        # bootstrap value for the unfinished tail
-        obs = np.asarray(self._obs, np.float32).reshape(-1)
-        _, _, last_value = self.policy.compute_actions(obs[None])
-        return batch, float(last_value[0])
+            rows[ACTIONS].append(action)
+            rows[REWARDS].append(rewards)
+            rows[DONES].append(dones)
+            rows[LOGPS].append(logp)
+            rows[VALUES].append(value)
+            self._ep_reward += rewards
+            if dones.any():
+                for i in np.nonzero(dones)[0]:
+                    self.episode_rewards.append(float(self._ep_reward[i]))
+                    self._ep_reward[i] = 0.0
+            self._obs = next_obs
+        batch = SampleBatch({k: np.stack(v) for k, v in rows.items()})
+        # bootstrap value for each env's unfinished tail
+        _, _, last_value = self.policy.compute_actions(self._obs)
+        if N == 1:
+            batch = SampleBatch({k: np.asarray(v)[:, 0] for k, v in batch.items()})
+            return batch, float(last_value[0])
+        return batch, np.asarray(last_value, np.float32)
 
     def sample(self, num_steps: int) -> SampleBatch:
+        """GAE-postprocessed batch, flattened to [T*N] rows for SGD."""
         batch, last_value = self._rollout(num_steps)
-        return compute_gae(batch, last_value, self.gamma, self.lam)
+        batch = compute_gae(batch, last_value, self.gamma, self.lam)
+        if self.num_envs > 1:
+            batch = SampleBatch(
+                {
+                    k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:])
+                    for k, v in batch.items()
+                }
+            )
+        return batch
 
     def sample_fragment(self, num_steps: int):
-        """IMPALA: raw time-ordered fragment + bootstrap value, no GAE —
-        the learner applies V-trace with the recorded behavior logps."""
+        """IMPALA/APPO: raw time-ordered fragment + bootstrap value, no
+        GAE — the learner applies V-trace with the recorded behavior
+        logps.  Shape [T] (scalar env) or [T, N] (vector env)."""
         return self._rollout(num_steps)
 
     def learn_local(

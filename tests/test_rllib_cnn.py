@@ -1,0 +1,290 @@
+"""RLlib round-4 surface: CNN policy, vector envs, replay buffers, DQN,
+APPO (reference tier: rllib/algorithms/*/tests learning checks +
+rllib/env/tests/test_vector_env.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.env import SyntheticPixelEnv, make_vector_env
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    RETURNS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _cartpole():
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
+# --------------------------------------------------------------- vector env
+
+
+def test_sync_vector_env_autoreset():
+    v = make_vector_env(_cartpole, num_envs=3, seed=0)
+    assert v.num_envs == 3
+    obs = v.reset(seed=0)
+    assert obs.shape == (3, 4)
+    total_dones = 0
+    for _ in range(300):
+        obs, rew, dones, infos = v.step(np.zeros(3, np.int64))  # always-left dies fast
+        assert obs.shape == (3, 4)
+        total_dones += int(dones.sum())
+    assert total_dones > 0, "always-left CartPole must terminate within 300 steps"
+
+
+def test_synthetic_pixel_env_contract():
+    env = SyntheticPixelEnv(num_envs=4, seed=0)
+    obs = env.reset(seed=0)
+    assert obs.shape == (4, 84, 84, 4) and obs.dtype == np.uint8
+    landed = 0
+    for _ in range(60):
+        obs, rew, dones, _ = env.step(np.ones(4, np.int32))
+        landed += int(dones.sum())
+        # terminal rewards only fire on landing steps
+        assert ((rew != 0) <= (dones | (rew != 0))).all()
+    assert landed >= 4, "ball falls 4px/step: every env lands multiple times in 60 steps"
+    assert obs.max() == 255 and obs.min() == 0
+
+
+def test_vectorized_gae_matches_scalar():
+    """GAE on a [T, N] batch must equal per-column scalar GAE."""
+    from ray_tpu.rllib.rollout_worker import compute_gae
+
+    rng = np.random.default_rng(0)
+    T, N = 12, 3
+    rewards = rng.standard_normal((T, N)).astype(np.float32)
+    values = rng.standard_normal((T, N)).astype(np.float32)
+    dones = (rng.random((T, N)) < 0.15).astype(np.float32)
+    last_value = rng.standard_normal(N).astype(np.float32)
+
+    vec = compute_gae(
+        SampleBatch({REWARDS: rewards.copy(), "vf_preds": values.copy(), DONES: dones.copy()}),
+        last_value,
+        gamma=0.99,
+        lam=0.95,
+    )
+    for j in range(N):
+        col = compute_gae(
+            SampleBatch(
+                {
+                    REWARDS: rewards[:, j].copy(),
+                    "vf_preds": values[:, j].copy(),
+                    DONES: dones[:, j].copy(),
+                }
+            ),
+            float(last_value[j]),
+            gamma=0.99,
+            lam=0.95,
+        )
+        np.testing.assert_allclose(vec[ADVANTAGES][:, j], col[ADVANTAGES], rtol=1e-5)
+        np.testing.assert_allclose(vec[RETURNS][:, j], col[RETURNS], rtol=1e-5)
+
+
+# --------------------------------------------------------------- CNN policy
+
+
+def test_cnn_policy_update_improves_surrogate():
+    from ray_tpu.rllib.policy import JaxPolicy
+
+    policy = JaxPolicy(
+        obs_shape=(84, 84, 4), num_actions=3, lr=1e-3,
+        model_config={"type": "cnn"},
+    )
+    rng = np.random.default_rng(0)
+    obs = rng.integers(0, 256, (32, 84, 84, 4), dtype=np.uint8)
+    batch = SampleBatch(
+        {
+            OBS: obs,
+            ACTIONS: rng.integers(0, 3, 32),
+            LOGPS: np.full(32, -1.0986, np.float32),
+            ADVANTAGES: rng.standard_normal(32).astype(np.float32),
+            RETURNS: rng.standard_normal(32).astype(np.float32),
+        }
+    )
+    m0 = policy.learn_on_batch(batch)
+    for _ in range(5):
+        m = policy.learn_on_batch(batch)
+    assert m["total_loss"] < m0["total_loss"], (m0, m)
+
+
+def test_cnn_multi_device_learner_matches_single():
+    """The pjit CNN learner over 8 devices must match the single-device
+    update bit-for-bit in expectation (small tolerance for reduction
+    order) — BASELINE config #3's multi-device learner covering the CNN."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from ray_tpu.rllib.policy import JaxPolicy
+
+    rng = np.random.default_rng(1)
+    obs = rng.integers(0, 256, (32, 42, 42, 4), dtype=np.uint8)
+    batch = SampleBatch(
+        {
+            OBS: obs,
+            ACTIONS: rng.integers(0, 3, 32),
+            LOGPS: np.full(32, -1.0986, np.float32),
+            ADVANTAGES: rng.standard_normal(32).astype(np.float32),
+            RETURNS: rng.standard_normal(32).astype(np.float32),
+        }
+    )
+    kw = dict(
+        obs_shape=(42, 42, 4),
+        num_actions=3,
+        lr=1e-3,
+        seed=3,
+        model_config={"type": "cnn", "conv_filters": ((16, 8, 4), (32, 4, 2))},
+    )
+    p1 = JaxPolicy(**kw)
+    p8 = JaxPolicy(num_devices=8, **kw)
+    for _ in range(2):
+        m1 = p1.learn_on_batch(batch)
+        m8 = p8.learn_on_batch(batch)
+    assert abs(m1["total_loss"] - m8["total_loss"]) < 1e-3, (m1, m8)
+    w1 = jax.tree_util.tree_leaves(p1.get_weights())
+    w8 = jax.tree_util.tree_leaves(p8.get_weights())
+    for a, b in zip(w1, w8):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_ppo_pixel_cnn_learns(ray_cluster):
+    """BASELINE config #3 shape: PPO with a CNN policy on a pixel env,
+    rollout actors + central learner, must improve."""
+    from ray_tpu.rllib.algorithm import AlgorithmConfig
+
+    def creator():
+        return SyntheticPixelEnv(num_envs=8, shaped=True, seed=7)
+
+    algo = (
+        AlgorithmConfig()
+        .environment(creator)
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=8)
+        .training(
+            lr=1e-3,
+            train_batch_size=640,
+            rollout_fragment_length=40,
+            sgd_minibatch_size=160,
+            num_sgd_iter=4,
+            model={"type": "cnn", "conv_filters": ((16, 8, 4), (32, 4, 2))},
+        )
+        .build()
+    )
+    try:
+        first = None
+        best = -np.inf
+        for _ in range(12):
+            r = algo.train()
+            if r["episodes_total"] > 0 and first is None:
+                first = r["episode_reward_mean"]
+            best = max(best, r["episode_reward_mean"])
+        assert first is not None
+        assert best > first + 0.15, (first, best)
+    finally:
+        algo.stop()
+
+
+# ------------------------------------------------------------ replay buffer
+
+
+def test_replay_buffer_ring_and_sample():
+    from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100, seed=0)
+    for start in range(0, 250, 50):
+        buf.add(
+            SampleBatch(
+                {
+                    OBS: np.arange(start, start + 50, dtype=np.float32).reshape(50, 1),
+                    ACTIONS: np.zeros(50, np.int64),
+                }
+            )
+        )
+    assert len(buf) == 100
+    s = buf.sample(64)
+    assert len(s) == 64
+    # ring: only the newest 100 rows survive
+    assert s[OBS].min() >= 150
+
+
+def test_prioritized_replay_prefers_high_priority():
+    from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=64, alpha=1.0, seed=0)
+    buf.add(SampleBatch({OBS: np.arange(64, dtype=np.float32).reshape(64, 1)}))
+    # give row 7 overwhelming priority
+    prio = np.full(64, 1e-3)
+    prio[7] = 1e3
+    buf.update_priorities(np.arange(64), prio)
+    s = buf.sample(256, beta=0.4)
+    frac = (s[OBS][:, 0] == 7).mean()
+    assert frac > 0.9, frac
+    assert s["weights"].min() > 0 and s["weights"].max() <= 1.0
+
+
+# ---------------------------------------------------------------- DQN/APPO
+
+
+def test_dqn_cartpole_learns(ray_cluster):
+    from ray_tpu.rllib.dqn import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment(_cartpole)
+        .rollouts(num_rollout_workers=2)
+        .training(
+            lr=1e-3,
+            buffer_size=20_000,
+            learning_starts=500,
+            rollout_fragment_length=200,
+            target_network_update_freq=400,
+            num_train_per_iter=64,
+            epsilon_timesteps=4_000,
+            train_batch_size=64,
+        )
+        .build()
+    )
+    try:
+        best = 0.0
+        for _ in range(16):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+        assert best > 80, best  # random play is ~20
+    finally:
+        algo.stop()
+
+
+def test_appo_cartpole_learns(ray_cluster):
+    from ray_tpu.rllib.appo import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment(_cartpole)
+        .rollouts(num_rollout_workers=2)
+        .training(lr=5e-3, rollout_fragment_length=100, entropy_coeff=0.01)
+        .build()
+    )
+    try:
+        best = 0.0
+        for _ in range(20):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+        assert best > 60, best
+    finally:
+        algo.stop()
