@@ -76,6 +76,12 @@ class GPT2Config:
     # GPipe microbatches per data shard when the mesh carries a pp axis
     # (bubble fraction (pp-1)/(M+pp-1))
     pp_microbatches: int = 4
+    # "gpipe": all-forward-then-autodiff-backward (activations for every
+    # in-flight microbatch live across the schedule); "1f1b": explicit
+    # per-microbatch backward with a min(M, 2pp-1)-deep activation ring —
+    # same gradients, O(pp) activation memory, so M can grow at a fixed
+    # budget and shrink the bubble (parallel/pipeline.py 1F1B notes)
+    pp_schedule: str = "gpipe"
     # >0 turns every MLP into a top-1 switch MoE with this many experts
     # (parallel/moe.py); experts shard over the ep mesh axis
     moe_experts: int = 0
@@ -195,16 +201,20 @@ class GPT2Model:
 
         On a pp mesh the stacked layer dim is the *stage* dim: sharded over
         pp (one contiguous slice of layers per stage, consumed by the GPipe
-        shard_map in backbone).  pp composes with dp/fsdp batch sharding;
-        pp×tp and pp×fsdp-param-sharding need megatron-style manual
-        collectives inside the stage and are rejected up front."""
+        shard_map in backbone).  pp composes with dp/fsdp batch sharding
+        AND with tp: the pipeline shard_map is manual over pp/dp/fsdp only,
+        so tp-sharded layer weights keep compiler-managed in-stage
+        collectives (shard_map manual-subset axes).  pp×sp (ring attention
+        inside a manual region) is rejected up front."""
         if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
             shape = dict(mesh.shape)
-            if shape.get("tp", 1) > 1 or shape.get("sp", 1) > 1:
+            if shape.get("sp", 1) > 1:
                 raise NotImplementedError(
-                    "pp composes with dp/fsdp (batch sharding); pp×tp and "
-                    "pp×sp are not supported yet"
+                    "pp composes with dp/fsdp (batch sharding) and tp; "
+                    "pp×sp is not supported yet"
                 )
+            if shape.get("tp", 1) > 1 and self.config.pp_schedule == "1f1b":
+                raise NotImplementedError("1f1b composes with dp/fsdp only")
             specs = self.param_pspecs(None)
 
             def relayer(spec):
@@ -456,6 +466,107 @@ class GPT2Model:
         returning f32 here would materialize an extra [B,S,V] f32 tensor."""
         x = self.backbone(params, tokens, mesh)
         return x @ params["wte"].astype(self.config.compute_dtype).T
+
+    def loss_and_grads_1f1b(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        targets: jax.Array,
+        mesh,
+    ):
+        """(loss, grads) via the explicit 1F1B pipeline schedule
+        (parallel/pipeline.py pipeline_train_1f1b): embedding runs at
+        stage 0, the final-norm + tied-head CE at the last stage, each
+        per-microbatch — gradients match the GPipe/sequential path while
+        live activations stay bounded by the pipe depth.  Composes with
+        dp/fsdp batch sharding; tp/sp/ep under 1F1B are rejected."""
+        import functools as _ft
+
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import shard_map_compat
+        from ray_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        cfg = self.config
+        cd = cfg.compute_dtype
+        shape = dict(mesh.shape)
+        if shape.get("tp", 1) > 1 or shape.get("sp", 1) > 1 or shape.get("ep", 1) > 1:
+            raise NotImplementedError("1f1b composes with dp/fsdp only")
+        pp = shape["pp"]
+        batch_axes = tuple(
+            a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+        )
+
+        def embed_fn(extra, tok_mb):
+            S = tok_mb.shape[1]
+            return extra["wte"].astype(cd)[tok_mb] + extra["wpe"].astype(cd)[:S][None]
+
+        def stage_fn(stage_layers, h):
+            def scan_body(x, layer_params):
+                if cfg.remat:
+                    y = jax.checkpoint(lambda x_, lp: self._layer(x_, lp, None))(
+                        x, layer_params
+                    )
+                else:
+                    y = self._layer(x, layer_params, None)
+                return y, None
+
+            out, _ = jax.lax.scan(scan_body, h, stage_layers)
+            return out
+
+        def loss_fn(extra, y, tgt_mb):
+            scale = extra["ln_f"]["scale"].astype(jnp.float32)
+            bias = extra["ln_f"]["bias"].astype(jnp.float32)
+            x32 = y.astype(jnp.float32)
+            mu = x32.mean(-1, keepdims=True)
+            var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+            h = ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias).astype(cd)
+            logits = (h @ extra["wte"].astype(cd).T).astype(jnp.float32)
+            if cfg.padded_vocab != cfg.vocab_size:
+                pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+                logits = jnp.where(pad_mask, -1e30, logits)
+            label_logit = jnp.take_along_axis(logits, tgt_mb[..., None], axis=-1)[..., 0]
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            return (lse - label_logit).mean()
+
+        def body(stage_layers, extra, tok_l, tgt_l):
+            B = tok_l.shape[0]
+            M = max(
+                d
+                for d in range(1, min(cfg.pp_microbatches, B) + 1)
+                if B % d == 0
+            )
+            tok_mbs = tok_l.reshape(M, B // M, *tok_l.shape[1:])
+            tgt_mbs = tgt_l.reshape(M, B // M, *tgt_l.shape[1:])
+            loss, sg, eg = pipeline_train_1f1b(
+                stage_layers,
+                extra,
+                tok_mbs,
+                tgt_mbs,
+                stage_fn=stage_fn,
+                embed_fn=embed_fn,
+                loss_fn=loss_fn,
+                reduce_axes=batch_axes,
+            )
+            return loss, sg, eg
+
+        def layer_spec(leaf):
+            return P("pp", *([None] * (leaf.ndim - 1)))
+
+        extra_params = {k: v for k, v in params.items() if k != "layers"}
+        layer_specs = jax.tree.map(layer_spec, params["layers"])
+        extra_specs = jax.tree.map(lambda _: P(), extra_params)
+        data_spec = P(batch_axes or None, None)
+
+        loss, sg, eg = shard_map_compat(
+            body,
+            mesh,
+            in_specs=(layer_specs, extra_specs, data_spec, data_spec),
+            out_specs=(P(), layer_specs, extra_specs),
+        )(params["layers"], extra_params, tokens, targets)
+        grads = dict(eg)
+        grads["layers"] = sg
+        return loss, grads
 
     def loss(
         self,

@@ -58,6 +58,14 @@ class LlamaConfig:
         return cls(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40, hidden_dim=13824, **kw)
 
     @classmethod
+    def llama_3b(cls, **kw) -> "LlamaConfig":
+        """~3.3B llama-family config sized for ONE 16G v5e chip in bf16
+        (6.7 GB weights + KV cache headroom; llama2_7b bf16 weights alone
+        are ~13.5 GB — 7B serving is a multi-chip mesh story).  head_dim
+        128 keeps the attention MXU/lane aligned."""
+        return cls(dim=3072, n_layers=26, n_heads=24, n_kv_heads=24, hidden_dim=8192, **kw)
+
+    @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         kw.setdefault("vocab_size", 256)
         kw.setdefault("max_seq_len", 64)

@@ -26,23 +26,41 @@ from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
 from ray_tpu.parallel.mesh import data_pspec
 
 
-def _tree_specs_for_opt_state(opt, params, param_specs):
+def _tree_specs_for_opt_state(opt, params, param_specs, mesh=None):
     """PartitionSpec tree for the optimizer state: moment tensors inherit
-    their param's spec (path-suffix match), scalars replicate."""
+    their param's spec (path-suffix match), scalars replicate.
+
+    ZeRO-1 completion: when the mesh carries an fsdp axis, moments whose
+    param is NOT fsdp-sharded (embeddings, final layernorm) still get a
+    shard — Adam's elementwise math lets the moments live sharded while
+    the param replicates; XLA all-gathers the sharded update before
+    apply.  This is exactly the reference FSDP/ZeRO-1 optimizer-state
+    memory split (torch train_loop_utils.py:29-31) without touching the
+    forward's tuned layouts."""
     from jax.tree_util import tree_flatten_with_path, tree_map_with_path
 
     flat, _ = tree_flatten_with_path(param_specs)
     by_path = {tuple(str(k) for k in path): spec for path, spec in flat}
     shapes = jax.eval_shape(opt.init, params)
+    fsdp_n = 0
+    if mesh is not None and "fsdp" in mesh.axis_names:
+        fsdp_n = mesh.shape["fsdp"]
 
     def leaf_spec(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return P()
         pstr = tuple(str(k) for k in path)
+        spec = P()
         for start in range(len(pstr)):
             if pstr[start:] in by_path:
-                return by_path[pstr[start:]]
-        return P()
+                spec = by_path[pstr[start:]]
+                break
+        if fsdp_n > 1 and all(a is None for a in spec):
+            # fully-replicated moment: shard the first fsdp-divisible dim
+            for d, size in enumerate(leaf.shape):
+                if size % fsdp_n == 0:
+                    return P(*([None] * d), "fsdp")
+        return spec
 
     return tree_map_with_path(leaf_spec, shapes)
 
@@ -102,7 +120,7 @@ def make_train_step(
     batch_sharding = NamedSharding(mesh, batch_spec)
 
     dummy = jax.eval_shape(model.init, jax.random.PRNGKey(0))
-    opt_specs = _tree_specs_for_opt_state(optimizer, dummy, param_specs)
+    opt_specs = _tree_specs_for_opt_state(optimizer, dummy, param_specs, mesh)
     opt_shardings = shard(opt_specs)
 
     @functools.partial(jax.jit, out_shardings=(param_shardings, opt_shardings))
@@ -113,6 +131,11 @@ def make_train_step(
     def loss_fn(params, tokens, targets):
         return model.loss(params, tokens, targets, mesh)
 
+    use_1f1b = (
+        dict(mesh.shape).get("pp", 1) > 1
+        and getattr(cfg, "pp_schedule", "gpipe") == "1f1b"
+    )
+
     @functools.partial(
         jax.jit,
         in_shardings=(param_shardings, opt_shardings, batch_sharding, batch_sharding),
@@ -120,7 +143,12 @@ def make_train_step(
         donate_argnums=(0, 1),
     )
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        if use_1f1b:
+            # explicit per-microbatch backward (activation memory bounded
+            # by pipe depth); grads arrive from inside the schedule
+            loss, grads = model.loss_and_grads_1f1b(params, tokens, targets, mesh)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)

@@ -103,18 +103,50 @@ def test_pp_gpt2_train_matches_sequential():
     np.testing.assert_allclose(seq, pp, rtol=2e-5, atol=2e-6)
 
 
-def test_pp_rejects_tp_sp():
-    """pp×tp / pp×sp need manual in-stage collectives — rejected up front
-    rather than silently mis-sharded."""
+def test_pp_rejects_sp():
+    """pp×sp (ring attention inside the manual pipeline region) is
+    rejected up front rather than silently mis-sharded; pp×tp is
+    supported via manual-subset shard_map (see test below)."""
     import jax
 
     from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
     from ray_tpu.parallel.mesh import MeshConfig, make_mesh
 
-    model = GPT2Model(GPT2Config.tiny())
-    mesh = make_mesh(MeshConfig(pp=2, tp=2, dp=2), jax.devices()[:8])
+    model = GPT2Model(GPT2Config.tiny(use_ring_attention=True))
+    mesh = make_mesh(MeshConfig(pp=2, sp=2, dp=2), jax.devices()[:8])
     with pytest.raises(NotImplementedError):
         model.param_pspecs(mesh)
+
+
+def test_pp_tp_matches_sequential():
+    """pp=2 × tp=2 × dp=2: GPipe manual over pp/dp with tp-sharded
+    in-stage matmuls left to the compiler (manual-subset shard_map) —
+    loss curve must equal the single-device run (VERDICT r3 ask #5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from ray_tpu.models.lm_train import make_train_step, synthetic_batch
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = GPT2Config.tiny(compute_dtype=jnp.float32, n_layer=4)
+    model = GPT2Model(cfg)
+    toks, tgts = synthetic_batch(jax.random.PRNGKey(1), 8, cfg.block_size, cfg.vocab_size)
+
+    def losses(mesh):
+        b = make_train_step(model, mesh, learning_rate=1e-3)
+        p, o = b.init(jax.random.PRNGKey(0))
+        t = jax.device_put(toks, b.batch_sharding)
+        y = jax.device_put(tgts, b.batch_sharding)
+        out = []
+        for _ in range(3):
+            p, o, m = b.step(p, o, t, y)
+            out.append(float(m["loss"]))
+        return out
+
+    seq = losses(make_mesh(MeshConfig(dp=1), jax.devices()[:1]))
+    pptp = losses(make_mesh(MeshConfig(pp=2, tp=2, dp=2), jax.devices()[:8]))
+    np.testing.assert_allclose(seq, pptp, rtol=2e-5, atol=2e-6)
 
 
 def test_pipeline_single_microbatch_edge():
@@ -131,4 +163,88 @@ def test_pipeline_single_microbatch_edge():
     out = jax.jit(piped)(params, x)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(_sequential(params, x)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_1f1b_matches_sequential_and_gpipe():
+    """1F1B explicit-backward schedule: loss curve equal to both the
+    sequential run and the GPipe schedule (VERDICT r3 ask #5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from ray_tpu.models.lm_train import make_train_step, synthetic_batch
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    toks = tgts = None
+
+    def losses(mesh, schedule, microbatches=4):
+        nonlocal toks, tgts
+        cfg = GPT2Config.tiny(
+            compute_dtype=jnp.float32,
+            n_layer=4,
+            pp_schedule=schedule,
+            pp_microbatches=microbatches,
+        )
+        model = GPT2Model(cfg)
+        if toks is None:
+            toks, tgts = synthetic_batch(
+                jax.random.PRNGKey(1), 8, cfg.block_size, cfg.vocab_size
+            )
+        b = make_train_step(model, mesh, learning_rate=1e-3)
+        p, o = b.init(jax.random.PRNGKey(0))
+        t = jax.device_put(toks, b.batch_sharding)
+        y = jax.device_put(tgts, b.batch_sharding)
+        out = []
+        for _ in range(3):
+            p, o, m = b.step(p, o, t, y)
+            out.append(float(m["loss"]))
+        return out
+
+    seq = losses(make_mesh(MeshConfig(dp=1), jax.devices()[:1]), "gpipe")
+    pp_mesh = make_mesh(MeshConfig(pp=2, dp=2, fsdp=2), jax.devices()[:8])
+    gpipe = losses(pp_mesh, "gpipe")
+    f1b = losses(pp_mesh, "1f1b")
+    np.testing.assert_allclose(seq, f1b, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(gpipe, f1b, rtol=2e-5, atol=2e-6)
+
+
+def test_1f1b_memory_bounded_vs_gpipe():
+    """The point of 1F1B: live activation memory bounded by the pipe depth
+    (ring of min(M, 2pp-1) stage inputs) instead of growing with M.
+    Compare XLA's compiled temp-buffer sizes at M=8 — 1F1B must be
+    meaningfully smaller."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from ray_tpu.models.lm_train import make_train_step, synthetic_batch
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    def temp_bytes(schedule, microbatches):
+        cfg = GPT2Config.tiny(
+            compute_dtype=jnp.float32,
+            n_layer=4,
+            remat=False,
+            pp_schedule=schedule,
+            pp_microbatches=microbatches,
+        )
+        model = GPT2Model(cfg)
+        mesh = make_mesh(MeshConfig(pp=4, keep_unit_axes=False), jax.devices()[:4])
+        b = make_train_step(model, mesh, learning_rate=1e-3)
+        toks, tgts = synthetic_batch(
+            jax.random.PRNGKey(1), 16, cfg.block_size, cfg.vocab_size
+        )
+        p, o = jax.eval_shape(b.init, jax.random.PRNGKey(0))
+        lowered = jax.jit(
+            b.step.__wrapped__ if hasattr(b.step, "__wrapped__") else b.step
+        ).lower(p, o, toks, tgts)
+        mem = lowered.compile().memory_analysis()
+        return int(getattr(mem, "temp_size_in_bytes", 0))
+
+    gpipe = temp_bytes("gpipe", 8)
+    f1b = temp_bytes("1f1b", 8)
+    assert f1b > 0 and gpipe > 0
+    assert f1b < 0.75 * gpipe, (
+        f"1f1b temp {f1b/1e6:.1f}MB not clearly below gpipe {gpipe/1e6:.1f}MB"
     )
