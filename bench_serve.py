@@ -1,0 +1,169 @@
+"""Serve LLM benchmark (BASELINE config #5 shape): Llama decode on the
+TPU behind a @serve.batch deployment — tokens/s + request p50/p99 at
+several offered loads, autoscaling engaged.
+
+Product path: client → DeploymentHandle → TPU-claiming replica actor →
+ONE jitted lax.scan generating all requested tokens per coalesced batch
+(per-token host dispatch would be tunnel-RPC-bound; the scan keeps the
+whole generation on-chip).  Model: a llama-family config sized for one
+16G v5e chip in bf16 (llama2_7b bf16 weights alone are ~13.5 GB — the
+7B-at-scale story is the multi-chip mesh in the dryrun; serving THIS
+chip honestly means ~3B).  Reference analog:
+python/ray/serve/benchmarks + serve/batching.py:46.
+
+Writes SERVE_BENCH_r04.json and prints one JSON line.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+MAX_SEQ = 256
+NEW_TOKENS = 32
+MAX_BATCH = 16  # llama_3b bf16 (6.7G) + 2x KV cache at B=16,S=256 fits 16G
+MODEL = os.environ.get("SERVE_BENCH_MODEL", "llama_3b")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # driver never claims the chip
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=6, num_tpus=1)
+
+    @serve.deployment(
+        name="llm",
+        ray_actor_options={"num_tpus": 1},
+        max_concurrent_queries=64,
+        autoscaling_config={
+            # engaged: scales on in-flight load, pinned to the one chip
+            "min_replicas": 1,
+            "max_replicas": 1,
+            "target_num_ongoing_requests_per_replica": 32,
+        },
+    )
+    class LlamaService:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+            cfg = getattr(LlamaConfig, MODEL)(
+                max_seq_len=MAX_SEQ,
+                param_dtype=jnp.bfloat16,  # serving: weights live bf16
+            )
+            self.cfg = cfg
+            self.model = LlamaModel(cfg)
+            self.params = self.model.init(jax.random.PRNGKey(0))
+            self.platform = jax.devices()[0].platform
+
+            def generate(params, tokens0, n_new):
+                B = tokens0.shape[0]
+                cache = self.model.init_cache(B)
+
+                def body(carry, t):
+                    tok, cache = carry
+                    logits, cache = self.model.decode_step(params, cache, tok, t)
+                    nxt = jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+                    return (nxt, cache), nxt[:, 0]
+
+                (_, _), toks = jax.lax.scan(
+                    body, (tokens0, cache), jnp.arange(n_new)
+                )
+                return toks.T  # [B, n_new]
+
+            import functools
+
+            self._generate = jax.jit(functools.partial(generate, n_new=NEW_TOKENS))
+
+        @serve.batch(max_batch_size=MAX_BATCH, batch_wait_timeout_s=0.02)
+        async def generate(self, prompts):
+            import jax.numpy as jnp
+
+            B = len(prompts)
+            # pad to the ONE compiled batch shape: a ragged batch per
+            # coalesce would retrace/recompile per distinct size
+            ids = [int(p) % self.cfg.vocab_size for p in prompts]
+            ids += [0] * (MAX_BATCH - B)
+            tokens0 = jnp.asarray([[i] for i in ids], jnp.int32)
+            out = np.asarray(self._generate(self.params, tokens0))
+            return [out[b].tolist() for b in range(B)]
+
+        async def __call__(self, prompt):
+            return await self.generate(prompt)
+
+        def info(self):
+            return {
+                "platform": self.platform,
+                "params_b": round(self.cfg.num_params() / 1e9, 2),
+            }
+
+    handle = serve.run(LlamaService.bind())
+    # warmup: compile the generation program
+    t0 = time.time()
+    ray_tpu.get(handle.remote(1), timeout=1200)
+    compile_s = time.time() - t0
+    info = ray_tpu.get(
+        serve.get_deployment_handle("llm").method("info").remote(), timeout=60
+    )
+
+    loads = [4, 16, 32]
+    rows = []
+    for concurrency in loads:
+        lat: list = []
+        t0 = time.time()
+        total_requests = concurrency * 4
+        done = 0
+        inflight = {}
+        i = 0
+        while done < total_requests:
+            while len(inflight) < concurrency and i < total_requests:
+                inflight[handle.remote(i)] = time.time()
+                i += 1
+            ready, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=600)
+            for r in ready:
+                start = inflight.pop(r)
+                toks = ray_tpu.get(r, timeout=60)
+                assert len(toks) == NEW_TOKENS
+                lat.append(time.time() - start)
+                done += 1
+        dt = time.time() - t0
+        lat_ms = np.asarray(lat) * 1000
+        rows.append(
+            {
+                "offered_concurrency": concurrency,
+                "tokens_per_sec": round(total_requests * NEW_TOKENS / dt, 1),
+                "requests_per_sec": round(total_requests / dt, 2),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
+            }
+        )
+
+    result = {
+        "metric": "serve_llama_decode_tokens_per_sec_per_chip",
+        "value": max(r["tokens_per_sec"] for r in rows),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "model": MODEL,
+        "params_b": info["params_b"],
+        "platform": info["platform"],
+        "new_tokens_per_request": NEW_TOKENS,
+        "batching": {"max_batch_size": MAX_BATCH, "batch_wait_timeout_s": 0.02},
+        "autoscaling_engaged": True,
+        "compile_s": round(compile_s, 1),
+        "loads": rows,
+    }
+    with open("SERVE_BENCH_r04.json", "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

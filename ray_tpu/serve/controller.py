@@ -55,11 +55,112 @@ class Replica:
 
 
 class ServeController:
-    """Detached actor: owns every deployment's goal state."""
+    """Detached actor: owns every deployment's goal state.
+
+    Goal state is CHECKPOINTED to the head KV on every mutation and
+    recovered on construction, so a controller crash/restart finds its
+    deployments — and re-acquires the still-living replica actors by
+    name — instead of losing everything (reference:
+    python/ray/serve/controller.py:154 checkpoint,
+    :305 _recover_config_from_checkpoint)."""
+
+    CKPT_KEY = "serve:controller:ckpt"
 
     def __init__(self):
         self.deployments: Dict[str, dict] = {}
         self.version = 0
+        self._recover()
+
+    # -------------------------------------------------- checkpoint/recover
+
+    def _core(self):
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod._require_connected()
+
+    def _checkpoint(self):
+        """Serialize every deployment's goal state (definition included,
+        via the same serializer actors use) + live replica names."""
+        import pickle
+
+        from ray_tpu._private import serialization
+
+        state = {}
+        for name, d in self.deployments.items():
+            state[name] = {
+                "definition": serialization.serialize(
+                    (d["cls"], d["init_args"], d["init_kwargs"])
+                ).to_wire(),
+                "target": d["target"],
+                "actor_options": d["actor_options"],
+                "route_prefix": d["route_prefix"],
+                "autoscaling": d["autoscaling"],
+                "max_concurrent_queries": d["max_concurrent_queries"],
+                "def_version": d.get("def_version", ""),
+                "gen": d.get("gen", 0),
+                "rseq": d.get("rseq", 0),
+                "replica_names": list(d.get("replica_names", [])),
+            }
+        try:
+            self._core().kv_put(
+                self.CKPT_KEY, pickle.dumps({"state": state, "version": self.version})
+            )
+        except Exception:
+            pass  # a lost checkpoint degrades recovery, never serving
+
+    def _recover(self):
+        import pickle
+
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu._private import serialization
+
+        try:
+            blob = self._core().kv_get(self.CKPT_KEY)
+        except Exception:
+            return
+        if not blob:
+            return
+        import ray_tpu
+
+        data = pickle.loads(blob)
+        self.version = data.get("version", 0)
+        for name, s in data.get("state", {}).items():
+            cls, init_args, init_kwargs = serialization.deserialize(
+                SerializedObject.from_wire(s["definition"])
+            )
+            dep = {
+                "name": name,
+                "cls": cls,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "target": s["target"],
+                "actor_options": s["actor_options"],
+                "route_prefix": s["route_prefix"],
+                "autoscaling": s["autoscaling"],
+                "max_concurrent_queries": s["max_concurrent_queries"],
+                "def_version": s.get("def_version", ""),
+                "gen": s.get("gen", 0),
+                "rseq": s.get("rseq", 0),
+                "replicas": [],
+                "replica_names": [],
+            }
+            self.deployments[name] = dep
+            # re-acquire replicas that survived the controller: they are
+            # NAMED actors, so the new controller finds them by name and
+            # keeps serving without a cold start
+            for rn in s.get("replica_names", []):
+                try:
+                    h = ray_tpu.get_actor(rn)
+                except Exception:
+                    continue
+                dep["replicas"].append(h)
+                dep["replica_names"].append(rn)
+            self._reconcile(name)
+        if self.deployments:
+            self.version += 1
+            for name in self.deployments:
+                self._publish_update(name)
+            self._checkpoint()
 
     def _publish_update(self, name: str):
         """Push the version bump to every handle (reference analog:
@@ -100,6 +201,9 @@ class ServeController:
             dep = {
                 "name": name,
                 "replicas": [],
+                "replica_names": [],
+                "gen": 0,
+                "rseq": 0,
                 "route_prefix": route_prefix or f"/{name}",
                 "max_concurrent_queries": max_concurrent_queries,
                 "autoscaling": autoscaling_config,
@@ -127,6 +231,7 @@ class ServeController:
         else:
             self._reconcile(name)
         self.version += 1
+        self._checkpoint()
         self._publish_update(name)
         if old:
             # retire the previous generation OFF the actor's call path: the
@@ -178,12 +283,21 @@ class ServeController:
                 pass
 
     def _spawn_replica(self, dep: dict):
+        """Replicas are NAMED actors (SERVE_REPLICA::<dep>::<gen>::<seq>)
+        so a recovered controller can re-acquire the living ones
+        (reference analog: the reference's named replica actors,
+        _private/deployment_state.py ReplicaName)."""
         import ray_tpu
 
+        rname = f"SERVE_REPLICA::{dep['name']}::{dep.get('gen', 0)}::{dep.get('rseq', 0)}"
+        dep["rseq"] = dep.get("rseq", 0) + 1
         actor_cls = ray_tpu.remote(Replica)
-        return actor_cls.options(**dict(dep["actor_options"])).remote(
+        opts = dict(dep["actor_options"])
+        opts["name"] = rname
+        handle = actor_cls.options(**opts).remote(
             dep["cls"], dep["init_args"], dep["init_kwargs"]
         )
+        return handle, rname
 
     def _rolling_replace(self, name: str) -> list:
         """Spin up the new generation, wait until it answers, swap it in,
@@ -192,12 +306,16 @@ class ServeController:
         import ray_tpu
 
         dep = self.deployments[name]
-        fresh = [self._spawn_replica(dep) for _ in range(dep["target"])]
+        dep["gen"] = dep.get("gen", 0) + 1
+        dep["rseq"] = 0
+        spawned = [self._spawn_replica(dep) for _ in range(dep["target"])]
+        fresh = [h for h, _ in spawned]
         try:
             ray_tpu.get([r.stats.remote() for r in fresh], timeout=120)
         except Exception:
             pass  # serve whatever came up; reconcile repairs stragglers
         old, dep["replicas"] = dep["replicas"], fresh
+        dep["replica_names"] = [n for _, n in spawned]
         return old
 
     def _reconcile(self, name: str):
@@ -205,9 +323,12 @@ class ServeController:
 
         dep = self.deployments[name]
         while len(dep["replicas"]) < dep["target"]:
-            dep["replicas"].append(self._spawn_replica(dep))
+            h, rname = self._spawn_replica(dep)
+            dep["replicas"].append(h)
+            dep["replica_names"].append(rname)
         while len(dep["replicas"]) > dep["target"]:
             victim = dep["replicas"].pop()
+            dep["replica_names"].pop()
             try:
                 ray_tpu.kill(victim)
             except Exception:
@@ -251,6 +372,7 @@ class ServeController:
                 dep["target"] = desired
                 self._reconcile(name)
                 self.version += 1
+                self._checkpoint()
                 self._publish_update(name)
         return self.version
 
@@ -265,6 +387,7 @@ class ServeController:
                 except Exception:
                     pass
         self.version += 1
+        self._checkpoint()
         self._publish_update(name)
         return True
 
