@@ -1,16 +1,23 @@
 from ray_tpu.data.dataset import (  # noqa: F401
     ActorPoolStrategy,
     Dataset,
+    from_arrow,
     from_items,
     from_numpy,
     range,
 )
 from ray_tpu.data.datasource import (  # noqa: F401
+    read_binary_files,
     read_csv,
     read_json,
+    read_numpy,
     read_parquet,
+    read_text,
+    read_tfrecords,
     write_csv,
     write_json,
+    write_numpy,
     write_parquet,
+    write_tfrecords,
 )
 from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401

@@ -1,0 +1,126 @@
+"""Block accessors: one protocol over the two block formats.
+
+Analog of the reference's BlockAccessor (reference: python/ray/data/
+block.py BlockAccessor.for_block; arrow blocks _internal/
+arrow_block.py:124 ArrowBlockAccessor; simple blocks
+_internal/simple_block.py).  A block is either a ``list`` of rows
+(simple) or a ``pyarrow.Table`` (columnar) — every block-level task in
+this package goes through these helpers so the two formats flow through
+the same transforms.  Tables keep columnar zero-copy semantics through
+the store (pickle5 buffers); lists keep arbitrary Python rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+import numpy as np
+
+
+def _is_table(block) -> bool:
+    try:
+        import pyarrow as pa
+
+        return isinstance(block, pa.Table)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def block_len(block) -> int:
+    if _is_table(block):
+        return block.num_rows
+    return len(block)
+
+
+def block_slice(block, start: int, end: int):
+    if _is_table(block):
+        return block.slice(start, max(0, end - start))
+    return block[start:end]
+
+
+def block_rows(block) -> Iterator[Any]:
+    """Iterate rows: Table rows come out as plain dicts."""
+    if _is_table(block):
+        yield from block.to_pylist()
+    else:
+        yield from block
+
+
+def block_concat(blocks: List[Any]):
+    """Concatenate same-format blocks; mixed input promotes to list."""
+    blocks = [b for b in blocks if block_len(b) > 0]
+    if not blocks:
+        return []
+    if all(_is_table(b) for b in blocks):
+        import pyarrow as pa
+
+        return pa.concat_tables(blocks, promote_options="default")
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(block_rows(b))
+    return out
+
+
+def block_sort(block, key: Callable):
+    rows = sorted(block_rows(block), key=key)
+    if _is_table(block):
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(rows, schema=block.schema if rows else None)
+    return rows
+
+
+def block_sample(block, k: int, seed: int) -> List[Any]:
+    """Up to k sample rows (plain values via key fn happens caller-side)."""
+    n = block_len(block)
+    if n == 0:
+        return []
+    rng = np.random.default_rng(seed)
+    idx = sorted(rng.choice(n, size=min(k, n), replace=False).tolist())
+    if _is_table(block):
+        rows = []
+        for i in idx:
+            rows.append(block.slice(i, 1).to_pylist()[0])
+        return rows
+    return [block[i] for i in idx]
+
+
+def block_select(block, indices) -> Any:
+    if _is_table(block):
+        return block.take(indices)
+    return [block[i] for i in indices]
+
+
+def block_to_batch(block, batch_format: str):
+    """numpy: dict-of-columns (or array); pyarrow: a Table; default: rows."""
+    if batch_format == "pyarrow":
+        if _is_table(block):
+            return block
+        import pyarrow as pa
+
+        rows = list(block_rows(block))
+        if rows and not isinstance(rows[0], dict):
+            rows = [{"value": r} for r in rows]
+        return pa.Table.from_pylist(rows)
+    if batch_format == "numpy":
+        if _is_table(block):
+            return {name: block.column(name).to_numpy(zero_copy_only=False)
+                    for name in block.column_names}
+        block = list(block)
+        if block and isinstance(block[0], dict):
+            return {k: np.asarray([r[k] for r in block]) for k in block[0]}
+        return np.asarray(block)
+    return list(block_rows(block))
+
+
+def batch_to_block(batch):
+    """Inverse of block_to_batch: a returned Table STAYS a Table block."""
+    if _is_table(batch):
+        return batch
+    if isinstance(batch, dict):
+        keys = list(batch)
+        n = len(batch[keys[0]])
+        return [{k: batch[k][i] for k in keys} for i in range(n)]
+    if isinstance(batch, np.ndarray):
+        return list(batch)
+    return list(batch)

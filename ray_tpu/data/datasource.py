@@ -1,12 +1,16 @@
-"""File datasources: parquet / csv / json(lines) read + write.
+"""File datasources: parquet / csv / json(lines) / numpy / text / binary
+/ tfrecords read + write.
 
 Analog of the reference's datasource layer (reference:
 python/ray/data/datasource/{parquet_datasource.py,csv_datasource.py,
-json_datasource.py} + read_api.py read_parquet/read_csv/read_json and
+json_datasource.py,numpy_datasource.py,text_datasource.py,
+binary_datasource.py,tfrecords_datasource.py} + read_api.py and
 Dataset.write_*): one read task per file (a block per file), one write
-task per block.  Blocks stay in the row format the rest of this Data
-layer uses (list of dicts); pyarrow handles the columnar conversion at
-the file boundary.
+task per block.  Parquet/CSV reads keep the pyarrow Table as the block
+(columnar end-to-end via ray_tpu/data/block.py accessors); json/text/
+binary produce row blocks.  TFRecords implements the framing format
+(length + masked-crc32c + payload) directly — records are raw bytes, no
+TensorFlow dependency.
 """
 
 from __future__ import annotations
@@ -33,9 +37,12 @@ def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
     return out
 
 
-def _rows_to_table(rows: List[dict]):
+def _rows_to_table(block):
     import pyarrow as pa
 
+    if isinstance(block, pa.Table):
+        return block
+    rows = list(block)
     if rows and not isinstance(rows[0], dict):
         rows = [{"value": r} for r in rows]
     return pa.Table.from_pylist(rows)
@@ -45,14 +52,15 @@ def _rows_to_table(rows: List[dict]):
 def _read_parquet_file(path: str, columns):
     import pyarrow.parquet as pq
 
-    return pq.read_table(path, columns=columns).to_pylist()
+    # the Table IS the block: columnar through every downstream transform
+    return pq.read_table(path, columns=columns)
 
 
 @ray_tpu.remote
 def _read_csv_file(path: str):
     import pyarrow.csv as pacsv
 
-    return pacsv.read_csv(path).to_pylist()
+    return pacsv.read_csv(path)
 
 
 @ray_tpu.remote
@@ -88,10 +96,160 @@ def _write_csv_block(block, path: str):
 def _write_json_block(block, path: str):
     import json
 
+    from ray_tpu.data.block import block_rows
+
     with open(path, "w") as f:
-        for row in block:
+        for row in block_rows(block):
             f.write(json.dumps(row) + "\n")
     return path
+
+
+_CRC_MASK = 0xA282EAD8
+
+
+def _masked_crc32c(data: bytes) -> int:
+    """TFRecord's masked crc32c (reference:
+    tensorflow/core/lib/hash/crc32c.h mask) — crc32c via the crc32c
+    package if present, else a pure-python table fallback."""
+    try:
+        import crc32c as _c
+
+        crc = _c.crc32c(data)
+    except ImportError:
+        crc = _crc32c_py(data)
+    return ((crc >> 15 | crc << 17) + _CRC_MASK) & 0xFFFFFFFF
+
+
+_CRC_TABLE = None
+
+
+def _crc32c_py(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+@ray_tpu.remote
+def _read_numpy_file(path: str):
+    import numpy as np
+
+    arr = np.load(path, allow_pickle=False)
+    return [{"data": row} for row in arr]
+
+
+@ray_tpu.remote
+def _read_text_file(path: str, encoding: str):
+    with open(path, "r", encoding=encoding) as f:
+        return [{"text": line.rstrip("\n")} for line in f]
+
+
+@ray_tpu.remote
+def _read_binary_file(path: str):
+    with open(path, "rb") as f:
+        return [{"path": path, "bytes": f.read()}]
+
+
+@ray_tpu.remote
+def _read_tfrecords_file(path: str):
+    import struct
+
+    rows = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                break
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:])
+            if len_crc != _masked_crc32c(header[:8]):
+                raise ValueError(f"corrupt tfrecord length crc in {path}")
+            payload = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if data_crc != _masked_crc32c(payload):
+                raise ValueError(f"corrupt tfrecord data crc in {path}")
+            rows.append({"record": payload})
+    return rows
+
+
+@ray_tpu.remote
+def _write_numpy_block(block, path: str):
+    import numpy as np
+
+    from ray_tpu.data.block import block_rows
+
+    rows = [r["data"] if isinstance(r, dict) and "data" in r else r for r in block_rows(block)]
+    np.save(path, np.asarray(rows), allow_pickle=False)
+    return path
+
+
+@ray_tpu.remote
+def _write_tfrecords_block(block, path: str):
+    import struct
+
+    from ray_tpu.data.block import block_rows
+
+    with open(path, "wb") as f:
+        for row in block_rows(block):
+            payload = row["record"] if isinstance(row, dict) else bytes(row)
+            header = struct.pack("<Q", len(payload))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc32c(header)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc32c(payload)))
+    return path
+
+
+def read_numpy(paths):
+    """.npy files, one block per file, rows {"data": arr_row} (reference:
+    numpy_datasource.py)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, ".npy")
+    return Dataset([_read_numpy_file.remote(p) for p in files])
+
+
+def read_text(paths, *, encoding: str = "utf-8", suffix: str = ".txt"):
+    """Line-per-row text files (reference: text_datasource.py)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, suffix)
+    return Dataset([_read_text_file.remote(p, encoding) for p in files])
+
+
+def read_binary_files(paths, *, suffix: str = ""):
+    """Whole-file bytes rows (reference: binary_datasource.py)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, suffix)
+    return Dataset([_read_binary_file.remote(p) for p in files])
+
+
+def read_tfrecords(paths, *, suffix: str = ".tfrecords"):
+    """TFRecord framing reader: rows {"record": bytes}; crc-checked
+    (reference: tfrecords_datasource.py — feature parsing is the
+    caller's map(), no TF dependency here)."""
+    from ray_tpu.data.dataset import Dataset
+
+    files = _expand_paths(paths, suffix)
+    return Dataset([_read_tfrecords_file.remote(p) for p in files])
+
+
+def write_numpy(ds, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, _write_numpy_block, ".npy")
+
+
+def write_tfrecords(ds, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, _write_tfrecords_block, ".tfrecords")
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None):
