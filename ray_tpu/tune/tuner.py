@@ -90,13 +90,114 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial or {"CPU": 1}
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # ------------------------------------------------- experiment persistence
+
+    def _experiment_dir(self) -> str:
+        import os
+        import time as _time
+
+        base = self.run_config.storage_path or os.path.expanduser(
+            "~/ray_tpu_results"
+        )
+        name = self.run_config.name
+        if not name:
+            name = f"tune_{int(_time.time())}"
+            self.run_config.name = name
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _save_state(self, exp_dir: str, trials: List[Trial]):
+        """Atomic experiment-state snapshot: trial table + configs +
+        histories + latest checkpoints (reference:
+        tune/execution/trial_runner.py checkpoint / experiment_state
+        files).  Actors are process state and are NOT saved — a restore
+        restarts live trials from their last checkpoint."""
+        import os
+        import pickle
+
+        state = {
+            "param_space": self.param_space,
+            "tune_config": self.tune_config,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "state": t.state,
+                    "last_metrics": t.last_metrics,
+                    "history": t.history,
+                    "latest_checkpoint": t.latest_checkpoint,
+                    "error": t.error,
+                }
+                for t in trials
+            ],
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Callable,
+        *,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: python/ray/tune/tuner.py:159 Tuner.restore):
+        TERMINATED/ERROR trials keep their results; PENDING/RUNNING/
+        STOPPED trials restart from their latest checkpoint on fit()."""
+        import os
+        import pickle
+
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        rc = run_config or RunConfig()
+        rc.name = os.path.basename(path.rstrip("/"))
+        rc.storage_path = os.path.dirname(path.rstrip("/"))
+        tuner = cls(
+            trainable,
+            param_space=state["param_space"],
+            tune_config=state["tune_config"],
+            run_config=rc,
+            resources_per_trial=resources_per_trial,
+        )
+        trials = []
+        for s in state["trials"]:
+            t = Trial(trial_id=s["trial_id"], config=s["config"])
+            t.state = s["state"]
+            t.last_metrics = s["last_metrics"]
+            t.history = s["history"]
+            t.latest_checkpoint = s["latest_checkpoint"]
+            t.error = s["error"]
+            # STOPPED trials were deliberately pruned by the scheduler —
+            # re-running them would burn the compute early stopping saved
+            if t.state in ("PENDING", "RUNNING"):
+                t.state = "PENDING"  # will restart from latest_checkpoint
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
-        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg) for i, cfg in enumerate(variants)]
-        pending = list(trials)
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            pending = [t for t in trials if t.state == "PENDING"]
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+            trials = [
+                Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                for i, cfg in enumerate(variants)
+            ]
+            pending = list(trials)
+        exp_dir = self._experiment_dir()
+        self._save_state(exp_dir, trials)
         running: List[Trial] = []
         actor_cls = ray_tpu.remote(FunctionTrainable)
 
@@ -120,13 +221,17 @@ class Tuner:
         while pending or running:
             while pending and len(running) < tc.max_concurrent_trials:
                 trial = pending.pop(0)
-                _start_trial(trial)
+                # restored trials resume from their last checkpoint
+                _start_trial(trial, checkpoint=trial.latest_checkpoint)
                 running.append(trial)
 
+            mutated = False
             for trial in list(running):
                 kind, payload = ray_tpu.get(
                     trial.actor.next_event.options(num_returns=1).remote(1.0), timeout=90
                 )
+                if kind != "pending":
+                    mutated = True
                 if kind == "report":
                     metrics, ckpt = payload
                     metrics.setdefault("training_iteration", len(trial.history) + 1)
@@ -161,6 +266,12 @@ class Tuner:
                     trial.error = payload
                     ray_tpu.kill(trial.actor)
                     running.remove(trial)
+            if mutated:
+                # snapshot only on actual trial-state transitions — a
+                # per-poll rewrite would re-pickle every history row each
+                # second of a long experiment
+                self._save_state(exp_dir, trials)
+        self._save_state(exp_dir, trials)
         errs = [t for t in trials if t.state == "ERROR"]
         if errs and len(errs) == len(trials):
             raise RuntimeError(f"all trials failed; first error:\n{errs[0].error}")
