@@ -69,6 +69,8 @@ class Cluster:
             "--resources",
             json.dumps(res),
         ]
+        if args.get("object_store_memory"):
+            cmd += ["--object-store-memory", str(int(args["object_store_memory"]))]
         logf = open(os.path.join(self.session_dir, "head.log"), "ab")
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=logf, start_new_session=True

@@ -389,12 +389,17 @@ class HeadServer:
         )
         self._log_tailer.start()
         # table persistence: restore surviving metadata from a prior head
-        # incarnation (detached actors restart on fresh workers), then keep
-        # snapshotting (analog: reference gcs_table_storage.h → Redis)
-        from ray_tpu.gcs.storage import GcsSnapshotStorage
+        # incarnation (detached actors restart on fresh workers; spilled /
+        # lineage-backed objects stay recoverable), then append every
+        # mutation to the WAL and compact when it grows (analog: reference
+        # gcs_table_storage.h → redis_store_client.h per-write persistence)
+        from ray_tpu.gcs.storage import GcsWalStorage
 
-        self._storage = GcsSnapshotStorage(os.path.join(self.session_dir, "gcs_snapshot.pkl"))
+        self._storage = GcsWalStorage(self.session_dir)
         self._restore_tables()
+        # identity record: lets the NEXT incarnation remap directory/spill
+        # entries that point at THIS head's (ephemeral) store segment
+        self._wal("head", self.head_node_id)
 
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._idle_reaper_loop())
@@ -408,7 +413,7 @@ class HeadServer:
         self._shutdown = True
         if self._storage is not None:
             try:
-                self._storage.save(self._snapshot_tables())
+                self._storage.compact(self._snapshot_tables())
             except Exception:
                 pass
         # kill all worker processes we know about
@@ -430,10 +435,23 @@ class HeadServer:
         except Exception:
             pass
 
-    # ------------------------------------------------------- table snapshots
+    # ---------------------------------------------- table persistence (WAL)
 
     def _mark_tables_dirty(self):
         self._tables_dirty = True
+
+    def _wal(self, *record):
+        """Append one table mutation to the WAL (never fatal)."""
+        if self._storage is None:
+            return
+        try:
+            self._storage.append(record)
+        except Exception:
+            pass
+
+    def _wal_locs(self, oid: bytes):
+        """Idempotent location upsert after any directory mutation."""
+        self._wal("loc=", bytes(oid), sorted(self.object_locations.get(oid, ())))
 
     def _snapshot_tables(self) -> dict:
         detached = []
@@ -450,15 +468,101 @@ class HeadServer:
             "jobs": dict(self.jobs),
             "detached_actors": detached,
             "pgs": pgs,
+            "head_node_id": self.head_node_id,
+            # object directory + spill registry + lineage: what makes a
+            # restarted head able to find / restore / reconstruct objects
+            "object_locations": {o: sorted(l) for o, l in self.object_locations.items()},
+            "object_spilled": dict(self.object_spilled),
+            "lineage": {o: s.to_wire() for o, s in self.lineage.items()},
+            "sealed": [o for o, e in self.objects.items() if e[0] == SEALED],
         }
 
     def _restore_tables(self):
-        snap = self._storage.load()
-        if not snap:
+        tables, records = self._storage.load()
+        if not tables and not records:
             return
-        self.kv.update(snap.get("kv", {}))
-        self.jobs.update(snap.get("jobs", {}))
-        for wire in snap.get("detached_actors", []):
+        st = {
+            "kv": {},
+            "jobs": {},
+            "detached": {},
+            "pgs": {},
+            "locs": {},
+            "spilled": {},
+            "lineage": {},
+            "sealed": set(),
+        }
+        old_heads = set()
+        if tables and tables.get("head_node_id"):
+            old_heads.add(bytes(tables["head_node_id"]))
+        if tables:
+            st["kv"].update(tables.get("kv", {}))
+            st["jobs"].update(tables.get("jobs", {}))
+            for wire in tables.get("detached_actors", []):
+                st["detached"][bytes(TaskSpec.from_wire(wire).actor_id)] = wire
+            for pg_id, bundles, strategy, name in tables.get("pgs", []):
+                st["pgs"][bytes(pg_id)] = (bundles, strategy, name)
+            st["locs"].update(
+                {bytes(o): set(l) for o, l in tables.get("object_locations", {}).items()}
+            )
+            st["spilled"].update(
+                {bytes(o): tuple(v) for o, v in tables.get("object_spilled", {}).items()}
+            )
+            st["lineage"].update(
+                {bytes(o): w for o, w in tables.get("lineage", {}).items()}
+            )
+            st["sealed"].update(bytes(o) for o in tables.get("sealed", []))
+        # replay the WAL over the base state, newest wins
+        for rec in records:
+            kind = rec[0]
+            try:
+                if kind == "kv":
+                    if rec[2] is None:
+                        st["kv"].pop(rec[1], None)
+                    else:
+                        st["kv"][rec[1]] = rec[2]
+                elif kind == "job":
+                    st["jobs"][rec[1]] = rec[2]
+                elif kind == "dactor":
+                    if rec[2] is None:
+                        st["detached"].pop(bytes(rec[1]), None)
+                    else:
+                        st["detached"][bytes(rec[1])] = rec[2]
+                elif kind == "pg":
+                    if rec[2] is None:
+                        st["pgs"].pop(bytes(rec[1]), None)
+                    else:
+                        st["pgs"][bytes(rec[1])] = tuple(rec[2])
+                elif kind == "seal":
+                    st["sealed"].add(bytes(rec[1]))
+                elif kind == "loc=":
+                    locs = {bytes(x) for x in rec[2]}
+                    if locs:
+                        st["locs"][bytes(rec[1])] = locs
+                    else:
+                        st["locs"].pop(bytes(rec[1]), None)
+                elif kind == "spill":
+                    if rec[2] is None:
+                        st["spilled"].pop(bytes(rec[1]), None)
+                    else:
+                        st["spilled"][bytes(rec[1])] = tuple(rec[2])
+                elif kind == "lineage":
+                    if rec[2] is None:
+                        st["lineage"].pop(bytes(rec[1]), None)
+                    else:
+                        st["lineage"][bytes(rec[1])] = rec[2]
+                elif kind == "obj-":
+                    oid = bytes(rec[1])
+                    st["locs"].pop(oid, None)
+                    st["spilled"].pop(oid, None)
+                    st["sealed"].discard(oid)
+                elif kind == "head":
+                    old_heads.add(bytes(rec[1]))
+            except Exception:
+                continue
+        # ---- materialize
+        self.kv.update(st["kv"])
+        self.jobs.update(st["jobs"])
+        for wire in st["detached"].values():
             spec = TaskSpec.from_wire(wire)
             if spec.actor_id in self.actors:
                 continue
@@ -474,30 +578,74 @@ class HeadServer:
             entry = TaskEntry(spec, -1)
             self.tasks[spec.task_id] = entry
             self.task_queue.append(entry)
-        for pg_id, bundles, strategy, name in snap.get("pgs", []):
-            if pg_id in self.pgs:
+        for pg_id, (bundles, strategy, name) in st["pgs"].items():
+            if pg_id not in self.pgs:
+                self.pgs[pg_id] = PlacementGroupInfo(pg_id, bundles, strategy, name)
+        for oid, locs in st["locs"].items():
+            # nodes re-register with their prior ids; stale entries for
+            # nodes that never come back are skipped by the pull path.
+            # Entries on a PRIOR head incarnation are gone for good (the
+            # new head created a fresh store segment): drop them so the
+            # wait path falls through to spill-restore / lineage.
+            locs = {n for n in locs if n not in old_heads}
+            if locs:
+                self.object_locations[oid] = set(locs)
+        for oid, (nid, spath) in st["spilled"].items():
+            # spill FILES survive head restarts; files spilled by the old
+            # head process are served by THIS head (same session dir)
+            if bytes(nid) in old_heads:
+                nid = self.head_node_id
+            self.object_spilled[oid] = (bytes(nid), spath)
+        for oid, wire in st["lineage"].items():
+            try:
+                spec = TaskSpec.from_wire(wire)
+            except Exception:
                 continue
-            self.pgs[pg_id] = PlacementGroupInfo(pg_id, bundles, strategy, name)
-        if snap.get("detached_actors") or snap.get("pgs"):
-            logger.info(
-                "restored %d detached actors, %d placement groups from snapshot",
-                len(snap.get("detached_actors", [])),
-                len(snap.get("pgs", [])),
-            )
+            self._record_lineage(spec, len(repr(wire)))
+        for oid in (
+            st["sealed"] | set(st["locs"]) | set(st["spilled"]) | set(st["lineage"])
+        ):
+            e = self._object_entry(oid)
+            e[0] = SEALED
+        logger.info(
+            "restored GCS tables: %d kv, %d detached actors, %d pgs, "
+            "%d object locations, %d spilled, %d lineage entries "
+            "(%d WAL records replayed)",
+            len(st["kv"]),
+            len(st["detached"]),
+            len(st["pgs"]),
+            len(st["locs"]),
+            len(st["spilled"]),
+            len(st["lineage"]),
+            len(records),
+        )
+        # fold everything into a fresh base so the next restart replays a
+        # short WAL
+        try:
+            self._storage.compact(self._snapshot_tables())
+        except Exception:
+            pass
 
     async def _persist_loop(self):
+        """Compaction pacing: the WAL already made every mutation durable;
+        this loop just folds it into the base snapshot when it grows (or
+        periodically while dirty, bounding replay length)."""
+        last_compact = time.time()
         while not self._shutdown:
             await asyncio.sleep(0.5)
-            if not self._tables_dirty:
+            grown = self._storage.wal_bytes > 4 * (1 << 20)
+            periodic = self._tables_dirty and time.time() - last_compact > 10.0
+            if not (grown or periodic):
                 continue
             self._tables_dirty = False
+            last_compact = time.time()
             try:
-                snap = self._snapshot_tables()
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._storage.save, snap
-                )
+                # ON the loop: snapshot + truncate must be atomic w.r.t.
+                # concurrent appends, or mutations between the snapshot
+                # and the truncate would vanish from both
+                self._storage.compact(self._snapshot_tables())
             except Exception:
-                logger.exception("GCS snapshot failed")
+                logger.exception("GCS compaction failed")
 
     # ----------------------------------------------------------- connections
 
@@ -590,6 +738,7 @@ class HeadServer:
         self._conn_kind[cid] = "driver"
         job_id = p.get("job_id", b"")
         self.jobs[job_id] = {"started_at": time.time(), "driver_pid": p.get("pid", 0)}
+        self._wal("job", job_id, self.jobs[job_id])
         self._mark_tables_dirty()
         self._worker_env.update(p.get("worker_env") or {})
         return {
@@ -668,9 +817,11 @@ class HeadServer:
         self.sched.remove_node(nid)
         # its object copies are gone with its store segment
         for oid, locs in list(self.object_locations.items()):
-            locs.discard(nid)
-            if not locs:
-                del self.object_locations[oid]
+            if nid in locs:
+                locs.discard(nid)
+                if not locs:
+                    del self.object_locations[oid]
+                self._wal_locs(oid)
         await self._publish("node", {"event": "dead", "node_id": nid})
         self._record_event("ERROR", "node", "node died", node_id=nid.hex())
         self._kick_scheduler()
@@ -773,6 +924,7 @@ class HeadServer:
 
     async def _destroy_actor(self, actor: ActorInfo, reason: str):
         if actor.detached:
+            self._wal("dactor", bytes(actor.actor_id), None)
             self._mark_tables_dirty()
         if actor.state == ACTOR_DEAD:
             return
@@ -823,6 +975,7 @@ class HeadServer:
     async def _seal_object(self, oid: bytes):
         e = self._object_entry(oid)
         e[0] = SEALED
+        self._wal("seal", bytes(oid))
         for fut in self.object_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(e)
@@ -843,6 +996,7 @@ class HeadServer:
         # node must not pollute the directory
         if node_id and bytes(node_id) in self.nodes:
             self.object_locations.setdefault(oid, set()).add(bytes(node_id))
+            self._wal_locs(oid)
 
     async def h_put_object(self, cid, conn, p):
         nid = p.get("node_id")
@@ -981,6 +1135,7 @@ class HeadServer:
                 locs.discard(dest_nid)
                 if not locs:
                     del self.object_locations[oid]
+                self._wal_locs(oid)
         while True:
             e = self._object_entry(oid)
             if e[0] == PENDING:
@@ -1012,6 +1167,7 @@ class HeadServer:
             # (analog: reference object_recovery_manager.h:90), then loop
             # back to wait for the re-executed task to seal
             self.object_locations.pop(oid, None)
+            self._wal_locs(oid)
             rec_err = self._reconstruct_object(oid)
             if rec_err is not None:
                 return {"state": "error", "error": err + "; " + rec_err}
@@ -1046,6 +1202,7 @@ class HeadServer:
         """Drop all copies: head store directly, remote nodes by directive
         (including any spill file)."""
         locs = self.object_locations.pop(oid, set())
+        self._wal("obj-", bytes(oid))
         for nid in locs:
             if nid == self.head_node_id:
                 self._store.delete(oid)
@@ -1127,11 +1284,13 @@ class HeadServer:
             )
         for oid, path in spilled.items():
             self.object_spilled[oid] = (nid, path)
+            self._wal("spill", bytes(oid), (nid, path))
             locs = self.object_locations.get(oid)
             if locs is not None:
                 locs.discard(nid)
                 if not locs:
                     del self.object_locations[oid]
+            self._wal_locs(oid)
 
     async def _restore_spilled(self, oid: bytes) -> Optional[str]:
         """Bring a spilled object back into its node's shm store."""
@@ -1169,6 +1328,7 @@ class HeadServer:
         if not ok:
             return f"ObjectLostError: restore of {oid.hex()[:16]} failed"
         self.object_spilled.pop(oid, None)
+        self._wal("spill", bytes(oid), None)
         self._add_location(oid, snid)
         return None
 
@@ -1230,6 +1390,7 @@ class HeadServer:
                 charged = True  # already recorded for this task
                 continue
             self.lineage[oid] = spec
+            self._wal("lineage", bytes(oid), spec.to_wire())
             self._lineage_bytes[oid] = 0 if charged else wire_size
             if not charged:
                 self._lineage_total += wire_size
@@ -1244,6 +1405,7 @@ class HeadServer:
         spec = self.lineage.pop(oid, None)
         if spec is None:
             return
+        self._wal("lineage", bytes(oid), None)
         self._lineage_total -= self._lineage_bytes.pop(oid, 0)
         self._unpin_args(spec)
 
@@ -1481,6 +1643,7 @@ class HeadServer:
         if spec.name:
             self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
         if spec.detached:
+            self._wal("dactor", bytes(spec.actor_id), spec.to_wire())
             self._mark_tables_dirty()
         for oid in spec.return_object_ids():
             self._object_entry(oid)
@@ -1565,6 +1728,7 @@ class HeadServer:
     async def h_create_pg(self, cid, conn, p):
         pg = PlacementGroupInfo(p["pg_id"], p["bundles"], p["strategy"], p.get("name", ""))
         self.pgs[pg.pg_id] = pg
+        self._wal("pg", bytes(pg.pg_id), (pg.bundles, pg.strategy, pg.name))
         self._mark_tables_dirty()
         self._try_place_pg(pg)
         self._kick_scheduler()
@@ -1666,6 +1830,7 @@ class HeadServer:
             return {"ready": False}
 
     async def h_remove_pg(self, cid, conn, p):
+        self._wal("pg", bytes(p["pg_id"]), None)
         self._mark_tables_dirty()
         pg = self.pgs.pop(p["pg_id"], None)
         if pg is None:
@@ -1707,6 +1872,7 @@ class HeadServer:
         key = p["key"]
         if p.get("overwrite", True) or key not in self.kv:
             self.kv[key] = p["value"]
+            self._wal("kv", key, p["value"])
             for fut in self._kv_waiters.pop(key, []):
                 if not fut.done():
                     fut.set_result(True)
@@ -1745,9 +1911,11 @@ class HeadServer:
         if p.get("prefix"):
             for k in [k for k in self.kv if k.startswith(p["key"])]:
                 del self.kv[k]
+                self._wal("kv", k, None)
                 n += 1
         elif p["key"] in self.kv:
             del self.kv[p["key"]]
+            self._wal("kv", p["key"], None)
             n = 1
         return {"deleted": n}
 
