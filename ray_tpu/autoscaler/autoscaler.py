@@ -46,6 +46,9 @@ class Autoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.launched: Dict[str, str] = {}  # handle -> node_type
         self._idle_since: Dict[str, float] = {}
+        # optional extra-demand hook (the monitor wires the
+        # request_resources floor through this — autoscaler/sdk.py)
+        self._extra_demand = None
 
     def _pending_demand(self) -> List[Dict[str, float]]:
         """Resource demands of queued (unplaceable) tasks from the head."""
@@ -68,6 +71,11 @@ class Autoscaler:
         nodes, launch what's missing, reap long-idle nodes.  Returns the
         launch decision per node type (for tests/observability)."""
         demands = self._pending_demand()
+        if self._extra_demand is not None:
+            try:
+                demands = demands + list(self._extra_demand())
+            except Exception:
+                pass
         to_launch: Dict[str, int] = {}
         if demands:
             # greedy first-fit-decreasing over node types (reference:

@@ -1,0 +1,121 @@
+"""Autoscaler SDK + standing monitor.
+
+Analog of the reference's programmatic scaling surface (reference:
+python/ray/autoscaler/sdk/sdk.py:206 request_resources — a resource
+FLOOR the autoscaler keeps satisfied regardless of queued demand — and
+_private/monitor.py:125 Monitor, the standing process wiring load
+metrics to scaling decisions at runtime).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+REQUEST_KV_KEY = "autoscaler:requested_resources"
+
+
+def request_resources(
+    num_cpus: Optional[int] = None,
+    bundles: Optional[List[Dict[str, float]]] = None,
+):
+    """Declare a resource floor: the monitor scales the cluster until the
+    requested bundles fit in TOTAL cluster resources, idle or not.  Each
+    call REPLACES the previous request (reference sdk semantics); pass
+    nothing to clear it."""
+    from ray_tpu._private import worker as worker_mod
+
+    req: List[Dict[str, float]] = []
+    if num_cpus:
+        req.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    if bundles:
+        req.extend(dict(b) for b in bundles)
+    cw = worker_mod._require_connected()
+    cw.kv_put(REQUEST_KV_KEY, json.dumps(req).encode())
+
+
+def _requested_bundles(cw) -> List[Dict[str, float]]:
+    try:
+        blob = cw.kv_get(REQUEST_KV_KEY)
+    except Exception:
+        return []
+    if not blob:
+        return []
+    try:
+        return [dict(b) for b in json.loads(blob)]
+    except Exception:
+        return []
+
+
+class Monitor:
+    """Standing monitor thread: every interval, fold queued-task demand +
+    the request_resources floor into the Autoscaler's reconcile pass
+    (reference: _private/monitor.py StandardAutoscaler.update driver)."""
+
+    def __init__(self, autoscaler, interval_s: float = 2.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_decision: Dict[str, int] = {}
+        # the floor augments queued-demand inside update()
+        autoscaler._extra_demand = self._floor_demand
+
+    def _floor_demand(self) -> List[Dict[str, float]]:
+        """Unmet part of the request_resources floor: bundles that do not
+        fit into the cluster's current TOTAL capacity."""
+        from ray_tpu._private import worker as worker_mod
+
+        import ray_tpu
+
+        try:
+            cw = worker_mod._require_connected()
+        except Exception:
+            return []
+        bundles = _requested_bundles(cw)
+        if not bundles:
+            return []
+        try:
+            nodes = ray_tpu.nodes()
+        except Exception:
+            return []
+        totals = [dict(n.get("Resources", {})) for n in nodes if n.get("Alive", True)]
+        unmet = []
+        for b in sorted(bundles, key=lambda d: -sum(d.values())):
+            placed = False
+            for t in totals:
+                if all(t.get(k, 0.0) >= v for k, v in b.items()):
+                    for k, v in b.items():
+                        t[k] = t.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(b)
+        return unmet
+
+    def start(self):
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.last_decision = self.autoscaler.update()
+                except Exception:
+                    pass
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=_loop, name="autoscaler-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def start_monitor(provider, node_types, *, interval_s: float = 2.0, **autoscaler_kw) -> Monitor:
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+
+    return Monitor(
+        Autoscaler(provider, node_types, **autoscaler_kw), interval_s
+    ).start()

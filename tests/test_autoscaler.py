@@ -104,3 +104,69 @@ def test_tpu_vm_provider_gcloud_commands():
     provider.terminate_node(handle)
     delete = calls[-1]
     assert delete[3] == "delete" and "--quiet" in delete
+
+
+def test_monitor_loop_scales_up_and_down(cluster):
+    """Standing monitor: queued demand scales up WITHOUT manual update()
+    calls; idle nodes are reaped after idle_timeout (reference:
+    _private/monitor.py:125)."""
+    ray_tpu.init(address=cluster.address)
+    from ray_tpu.autoscaler.sdk import start_monitor
+
+    provider = FakeMultiNodeProvider(cluster.address, cluster.session_dir)
+    monitor = start_monitor(
+        provider,
+        {"cpu_worker": {"resources": {"CPU": 4.0, "bonus": 4.0}}},
+        interval_s=0.5,
+        max_workers=2,
+        idle_timeout_s=3.0,
+    )
+
+    @ray_tpu.remote(resources={"bonus": 1.0})
+    def needs_bonus():
+        time.sleep(0.5)
+        return 1
+
+    try:
+        refs = [needs_bonus.remote() for _ in range(3)]
+        # the monitor notices the queued demand and launches a node
+        assert ray_tpu.get(refs, timeout=180) == [1, 1, 1]
+        assert len(provider.non_terminated_nodes()) >= 1
+        # after idle_timeout with nothing queued, the node is reaped
+        deadline = time.time() + 60
+        while time.time() < deadline and provider.non_terminated_nodes():
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes(), "idle node never reaped"
+    finally:
+        monitor.stop()
+        provider.shutdown()
+
+
+def test_request_resources_floor(cluster):
+    """request_resources scales the cluster to the requested floor even
+    with zero queued tasks (reference: autoscaler/sdk/sdk.py:206)."""
+    ray_tpu.init(address=cluster.address)
+    from ray_tpu.autoscaler.sdk import request_resources, start_monitor
+
+    provider = FakeMultiNodeProvider(cluster.address, cluster.session_dir)
+    monitor = start_monitor(
+        provider,
+        {"cpu_worker": {"resources": {"CPU": 4.0}}},
+        interval_s=0.5,
+        max_workers=2,
+        idle_timeout_s=9999,
+    )
+    try:
+        # head has 1 CPU; ask for 5 CPUs total -> needs a worker node
+        request_resources(num_cpus=5)
+        deadline = time.time() + 60
+        while time.time() < deadline and not provider.non_terminated_nodes():
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes(), "floor request never scaled up"
+        # floor satisfied: a second pass must not launch more
+        time.sleep(2.0)
+        assert len(provider.non_terminated_nodes()) <= 2
+        request_resources()  # clear
+    finally:
+        monitor.stop()
+        provider.shutdown()
