@@ -29,7 +29,16 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "scheduler_spread_threshold": (float, 0.5, "hybrid policy: utilization above which we spread"),
     "scheduler_top_k_fraction": (float, 0.2, "hybrid policy: fraction of nodes in the top-k set"),
     "worker_pool_min_idle": (int, 0, "prestarted idle workers per node"),
-    "worker_pool_max_workers": (int, 64, "hard cap of worker processes per node"),
+    # fork-bomb backstop only — actors each need a worker process, so the
+    # real bound is resources/RAM, not this (reference: no total cap;
+    # maximum_startup_concurrency caps concurrent STARTS instead)
+    "worker_pool_max_workers": (int, 2048, "hard cap of worker processes per node"),
+    "worker_startup_concurrency": (
+        int,
+        0,
+        "max concurrently-starting workers per node; 0 = #CPUs (reference: "
+        "maximum_startup_concurrency)",
+    ),
     "idle_worker_kill_s": (float, 300.0, "kill idle workers after this long"),
     "memory_usage_threshold": (float, 0.95, "node memory fraction above which the OOM policy kills a retriable worker"),
     "memory_monitor_interval_s": (float, 2.0, "OOM policy check period; 0 disables"),

@@ -42,6 +42,7 @@ class MsgType(enum.IntEnum):
 
     # tasks (analog: core_worker.proto PushTask, node_manager RequestWorkerLease)
     SUBMIT_TASK = 20
+    SUBMIT_TASKS = 26  # batched submit: a burst of .remote() in one frame
     PUSH_TASK = 21
     TASK_DONE = 22
     CANCEL_TASK = 23

@@ -1,0 +1,163 @@
+"""Scale-envelope exercises: where does this core fall over?
+
+Analog of the reference's scalability envelope
+(reference: release/benchmarks/README.md:9-31 — 10k+ running tasks,
+10k+ actors, 1M+ queued tasks, 1 GiB broadcast — measured on 64x64-core
+cloud clusters).  This harness runs the same SHAPES at the scale the
+host machine supports and publishes the achieved numbers + timings;
+`python -m ray_tpu._private.ray_scale` writes one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_many_tasks(n: int = 10_000, chunk: int = 1_000) -> dict:
+    """n tiny tasks submitted and completed (reference envelope: 10k+
+    simultaneously running; here: submitted+drained through the star)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    ray_tpu.get([tiny.remote(i) for i in range(16)], timeout=120)  # warm pool
+    t0 = time.perf_counter()
+    done = 0
+    for start in range(0, n, chunk):
+        refs = [tiny.remote(i) for i in range(start, min(start + chunk, n))]
+        out = ray_tpu.get(refs, timeout=600)
+        assert out[0] == start
+        done += len(out)
+    dt = time.perf_counter() - t0
+    return {"tasks": done, "seconds": round(dt, 2), "tasks_per_sec": round(done / dt, 1)}
+
+
+def bench_queued_tasks(n: int = 10_000) -> dict:
+    """n tasks queued at once (reference envelope: 1M+ queued on one
+    64-core node): submit the full backlog, then drain."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [tiny.remote(i) for i in range(n)]
+    submit_dt = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=1200)
+    total_dt = time.perf_counter() - t0
+    assert out[-1] == n - 1
+    return {
+        "queued": n,
+        "submit_seconds": round(submit_dt, 2),
+        "submit_per_sec": round(n / submit_dt, 1),
+        "drain_seconds": round(total_dt, 2),
+        "throughput_per_sec": round(n / total_dt, 1),
+    }
+
+
+def bench_many_actors(budget_s: float = 120.0, batch: int = 50, cap: int = 1_000) -> dict:
+    """How many live actors fit in the time budget (reference envelope:
+    10k+ actors cluster-wide on 64 nodes; one actor = one worker
+    process here, so this is process-spawn bound on small hosts)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return b"ok"
+
+    actors = []
+    t0 = time.perf_counter()
+    while len(actors) < cap and time.perf_counter() - t0 < budget_s:
+        fresh = [A.remote() for _ in range(batch)]
+        ray_tpu.get([a.ping.remote() for a in fresh], timeout=600)
+        actors.extend(fresh)
+    create_dt = time.perf_counter() - t0
+    # one round of calls across EVERY live actor
+    t1 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    call_dt = time.perf_counter() - t1
+    n = len(actors)
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    return {
+        "actors": n,
+        "create_seconds": round(create_dt, 2),
+        "actors_per_sec": round(n / create_dt, 2),
+        "full_sweep_calls_per_sec": round(n / call_dt, 1),
+    }
+
+
+def bench_broadcast(mb: int = 100, nodes: int = 4) -> dict:
+    """One ~mb MiB object broadcast to `nodes` raylets (reference
+    envelope: 1 GiB to 50+ nodes): every node pulls the object once via
+    its transfer agent, tasks on each node touch it."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    handles = [c.add_node(num_cpus=1) for _ in range(nodes)]
+    try:
+        ray_tpu.init(address=c.address)
+        payload = np.random.default_rng(0).integers(
+            0, 255, mb * 1024 * 1024, dtype=np.uint8
+        )
+        ref = ray_tpu.put(payload)
+
+        @ray_tpu.remote
+        def checksum(a):
+            return int(a[::65537].sum())
+
+        expect = int(payload[::65537].sum())
+        # one task per node (node affinity via per-node custom resource is
+        # not needed: each raylet has 1 CPU, so tasks spread)
+        t0 = time.perf_counter()
+        out = ray_tpu.get(
+            [checksum.remote(ref) for _ in range(nodes)], timeout=1200
+        )
+        dt = time.perf_counter() - t0
+        assert all(o == expect for o in out)
+        return {
+            "mb": mb,
+            "nodes": nodes,
+            "seconds": round(dt, 2),
+            "aggregate_mb_per_sec": round(mb * nodes / dt, 1),
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+
+
+def main():
+    import ray_tpu
+
+    results = {"nproc": os.cpu_count()}
+    ray_tpu.init(num_cpus=4)
+    try:
+        results["many_tasks_10k"] = bench_many_tasks(10_000)
+        results["queued_tasks_10k"] = bench_queued_tasks(10_000)
+        results["many_actors"] = bench_many_actors(
+            budget_s=float(os.environ.get("SCALE_ACTOR_BUDGET_S", "120"))
+        )
+    finally:
+        ray_tpu.shutdown()
+    results["broadcast_100mb_4nodes"] = bench_broadcast(100, 4)
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,11 @@ class TaskSpec:
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     # set when the worker owning this actor should claim the real TPU chip
     claim_tpu: bool = False
+    # actor creation with the DEFAULT CPU demand: 1 CPU is required to
+    # schedule the creation but released once the actor is ALIVE
+    # (reference semantics: actors use 0 CPU after creation unless
+    # num_cpus was explicit)
+    implicit_cpu: bool = False
     # span context when tracing is on (util/tracing.py): trace_id /
     # parent_span_id / span_id — the reference's injected span metadata
     # (tracing_helper.py _DictPropagator)

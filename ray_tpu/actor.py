@@ -137,6 +137,9 @@ class ActorClass:
             resources=_normalize_resources(
                 opts.get("num_cpus"), opts.get("num_tpus"), opts.get("resources")
             ),
+            # default-CPU actors: 1 CPU to schedule creation, 0 held while
+            # running (reference actor semantics)
+            implicit_cpu=opts.get("num_cpus") is None,
             max_restarts=opts.get("max_restarts", RayConfig.actor_max_restarts),
             max_concurrency=opts.get("max_concurrency", 1),
             name=opts.get("name", ""),

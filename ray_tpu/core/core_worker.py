@@ -135,6 +135,9 @@ class CoreWorker:
         self._local_refs: Dict[bytes, int] = {}
         self._refs_lock = threading.Lock()
         self._pending_removals: List[bytes] = []
+        self._pending_adds: List[bytes] = []
+        self._submit_buffer: List[dict] = []
+        self._submit_flush_scheduled = False
         self._exported_functions: Dict[bytes, bool] = {}
         self._fetched_functions: Dict[bytes, Any] = {}
         self._actor_seq: Dict[bytes, int] = {}
@@ -233,28 +236,39 @@ class CoreWorker:
     async def _gc_flush_loop(self):
         while True:
             await asyncio.sleep(0.2)
-            batch = None
+            adds = removals = None
             with self._refs_lock:
+                if self._pending_adds:
+                    adds, self._pending_adds = self._pending_adds, []
                 if self._pending_removals:
-                    batch, self._pending_removals = self._pending_removals, []
-            if batch:
+                    removals, self._pending_removals = self._pending_removals, []
+            # adds flush BEFORE removals so this process's +/- pairs can
+            # never transiently go negative at the head
+            if adds:
                 try:
-                    await self.conn.request(MsgType.REMOVE_REF, {"object_ids": batch}, 10)
+                    await self.conn.request(MsgType.ADD_REF, {"object_ids": adds}, 10)
+                except Exception:
+                    pass
+            if removals:
+                try:
+                    await self.conn.request(
+                        MsgType.REMOVE_REF, {"object_ids": removals}, 10
+                    )
                 except Exception:
                     pass
 
     # ------------------------------------------------------------- refcounts
 
     def _add_local_ref(self, oid: bytes):
+        # batched like removals (one request per flush cycle, not per ref):
+        # a .remote() burst creating thousands of return refs must not pay
+        # a head round trip each — ordering vs removals is preserved by the
+        # adds-first flush
         with self._refs_lock:
             n = self._local_refs.get(oid, 0)
             self._local_refs[oid] = n + 1
-            first = n == 0
-        if first and self.connected:
-            try:
-                self.io.spawn(self.conn.request(MsgType.ADD_REF, {"object_ids": [oid]}, 10))
-            except Exception:
-                pass
+            if n == 0:
+                self._pending_adds.append(oid)
 
     def _remove_local_ref(self, oid: bytes):
         with self._refs_lock:
@@ -493,33 +507,61 @@ class CoreWorker:
                         )
                     return out
 
-                # one concurrent WAIT_OBJECT per missing ref: each reply may
-                # embed a cross-node transfer (the head pulls the object onto
-                # OUR node before replying "sealed"), so issuing them together
-                # lets the agents overlap the copies
-                async def _wait_all():
-                    return await asyncio.gather(
-                        *[
-                            self.conn.request(
-                                MsgType.WAIT_OBJECT,
-                                {"object_id": oid, "timeout": rem, "node_id": self.node_id},
-                                (rem + 5) if rem is not None else 3600,
-                            )
-                            for _, oid in pending
-                        ]
-                    )
-
-                replies = self.io.call(_wait_all())
-                for (i, oid), reply in zip(pending, replies):
-                    state = reply.get("state")
-                    if state == "timeout":
-                        raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
-                    if state == "error":
-                        raise _error_from_string(reply.get("error", "task failed"))
+                # ONE batched wait for every missing ref (the head wakes us
+                # as they all seal) — then read the local store; only refs
+                # that are sealed-but-not-local (remote copies needing a
+                # transfer, or head-side errors) fall back to the per-oid
+                # WAIT_OBJECT form whose reply embeds the cross-node pull
+                distinct_ids = list(dict.fromkeys(oid for _, oid in pending))
+                reply = self.request(
+                    MsgType.WAIT_OBJECT,
+                    {
+                        "object_ids": distinct_ids,
+                        "num_ready": len(distinct_ids),
+                        "timeout": rem,
+                    },
+                    timeout=(rem + 10) if rem is not None else 3600,
+                )
+                sealed = {bytes(o) for o in reply.get("ready", [])}
+                distinct = set(distinct_ids)
+                if len(sealed & distinct) < len(distinct) and deadline is not None:
+                    missing = next(o for _, o in pending if o not in sealed)
+                    raise GetTimeoutError(f"get() timed out on {missing.hex()[:16]}")
+                slow = []
+                for i, oid in pending:
                     sobj = self.store.get_serialized(oid)
-                    if sobj is None:
-                        sobj = self._refetch_evicted(oid, deadline)
-                    out[i] = self._materialize(sobj)
+                    if sobj is not None:
+                        out[i] = self._materialize(sobj)
+                    else:
+                        slow.append((i, oid))
+                if slow:
+                    rem = None
+                    if deadline is not None:
+                        rem = max(0.0, deadline - time.monotonic())
+
+                    async def _wait_all():
+                        return await asyncio.gather(
+                            *[
+                                self.conn.request(
+                                    MsgType.WAIT_OBJECT,
+                                    {"object_id": oid, "timeout": rem, "node_id": self.node_id},
+                                    (rem + 5) if rem is not None else 3600,
+                                )
+                                for _, oid in slow
+                            ]
+                        )
+
+                    replies = self.io.call(_wait_all())
+                    for (i, oid), reply in zip(slow, replies):
+                        state = reply.get("state")
+                        if state == "timeout":
+                            raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
+                        if state == "error":
+                            raise _error_from_string(reply.get("error", "task failed"))
+                        sobj = self.store.get_serialized(oid)
+                        if sobj is None:
+                            sobj = self._refetch_evicted(oid, deadline)
+                        out[i] = self._materialize(sobj)
             finally:
                 self._notify_blocked(False)
         return out
@@ -778,7 +820,7 @@ class CoreWorker:
         # way the caller could act on (failures seal into the return
         # objects), and a sync round trip per submit would serialize
         # batched submissions (reference analog: async SubmitTask)
-        self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()}))
+        self._enqueue_submit(spec)
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
 
     def create_actor(
@@ -797,6 +839,7 @@ class CoreWorker:
         pg_id: Optional[bytes],
         pg_bundle_index: int,
         runtime_env: Optional[dict] = None,
+        implicit_cpu: bool = False,
     ) -> ObjectRef:
         from ray_tpu._private.ids import ActorID
 
@@ -811,6 +854,7 @@ class CoreWorker:
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
             task_type=ACTOR_CREATION_TASK,
+            implicit_cpu=implicit_cpu,
             function_id=function_id,
             function_name=class_name,
             actor_id=actor_id,
@@ -879,8 +923,31 @@ class CoreWorker:
         # way the caller could act on (failures seal into the return
         # objects), and a sync round trip per submit would serialize
         # batched submissions (reference analog: async SubmitTask)
-        self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": spec.to_wire()}))
+        self._enqueue_submit(spec)
         return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
+
+    def _enqueue_submit(self, spec: TaskSpec):
+        """Coalesce a .remote() burst into few SUBMIT_TASKS frames: the
+        flush coroutine drains whatever accumulated by the time the io
+        loop runs it, so a tight submission loop pays ~one frame per loop
+        wakeup instead of one per task (order preserved)."""
+        with self._refs_lock:
+            self._submit_buffer.append(spec.to_wire())
+            if self._submit_flush_scheduled:
+                return
+            self._submit_flush_scheduled = True
+        self.io.spawn(self._flush_submits())
+
+    async def _flush_submits(self):
+        with self._refs_lock:
+            batch, self._submit_buffer = self._submit_buffer, []
+            self._submit_flush_scheduled = False
+        if not batch:
+            return
+        if len(batch) == 1:
+            await self.conn.send(MsgType.SUBMIT_TASK, {"spec": batch[0]})
+        else:
+            await self.conn.send(MsgType.SUBMIT_TASKS, {"specs": batch})
 
     # -------------------------------------------------- direct actor calls
 
@@ -1242,6 +1309,17 @@ class CoreWorker:
         exec_end: float = 0.0,
         contained: Optional[Dict[bytes, List[bytes]]] = None,
     ):
+        # refs this task created locally (e.g. deserialized ref-args kept
+        # in actor state) must be declared BEFORE the head unpins the args
+        # on TASK_DONE, or the batched add could lose the race with a
+        # driver-side delete
+        with self._refs_lock:
+            adds, self._pending_adds = self._pending_adds, []
+        if adds:
+            try:
+                self.request(MsgType.ADD_REF, {"object_ids": adds})
+            except Exception:
+                pass
         self.io.call(
             self.conn.send(
                 MsgType.TASK_DONE,

@@ -73,6 +73,38 @@ class DashboardServer:
 
             return _json(list_objects())
 
+        async def api_serve_get(request):
+            """Serve application status (reference: the dashboard serve
+            module backing `serve status`)."""
+            from ray_tpu.serve import schema as serve_schema
+
+            try:
+                return _json(serve_schema.status())
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+
+        async def api_serve_put(request):
+            """Declarative deploy: PUT a ServeApplicationSchema JSON
+            (reference: serve REST API, serve/schema.py)."""
+            import asyncio as _aio
+
+            from ray_tpu.serve import schema as serve_schema
+
+            try:
+                cfg = await request.json()
+            except Exception:
+                return web.json_response({"error": "invalid JSON"}, status=400)
+            try:
+                # apply() blocks on actor round trips: keep the http loop live
+                out = await _aio.get_running_loop().run_in_executor(
+                    None, serve_schema.apply, cfg
+                )
+                return _json(out)
+            except (ValueError, ImportError, AttributeError) as e:
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+
         async def index(request):
             total = ray_tpu.cluster_resources()
             avail = ray_tpu.available_resources()
@@ -114,6 +146,8 @@ class DashboardServer:
         app.router.add_get("/api/timeline", api_timeline)
         app.router.add_get("/api/events", api_events)
         app.router.add_get("/api/objects", api_objects)
+        app.router.add_get("/api/serve/applications", api_serve_get)
+        app.router.add_put("/api/serve/applications", api_serve_put)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", self.port)

@@ -1,0 +1,101 @@
+"""Grafana dashboard template for the exported Prometheus metrics.
+
+Analog of the reference's metrics module (reference:
+dashboard/modules/metrics/ — ships Grafana dashboard JSON templates and
+a default Prometheus scrape config pointing at the per-node agents).
+``grafana_dashboard()`` emits an importable dashboard JSON covering the
+metric families ray_tpu exposes (util/metrics.py + the per-node
+/metrics endpoints, raylet/metrics_agent.py); ``prometheus_scrape_config``
+emits the matching scrape stanza.  The dashboard CLI writes both:
+``python -m ray_tpu.dashboard.metrics_templates OUTDIR``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def _panel(panel_id: int, title: str, expr: str, y: int, unit: str = "short") -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{"expr": expr, "refId": "A"}],
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+    }
+
+
+def grafana_dashboard() -> Dict[str, Any]:
+    panels: List[Dict[str, Any]] = []
+    # the metric families the per-node agents actually export
+    # (raylet/metrics_agent.py _node_stats_text)
+    rows = [
+        ("Node CPU %", 'node_cpu_percent', "percent"),
+        ("Node memory used", "node_mem_used_bytes", "bytes"),
+        ("Node load (1m)", "node_load1", "short"),
+        ("Object store used", "object_store_used_bytes", "bytes"),
+        ("Object store capacity", "object_store_capacity_bytes", "bytes"),
+        ("Objects resident", "object_store_num_objects", "short"),
+        ("LRU evictions / s", "rate(object_store_evictions_total[1m])", "ops"),
+        ("Store fill fraction", "object_store_used_bytes / object_store_capacity_bytes", "percentunit"),
+    ]
+    for i, (title, expr, unit) in enumerate(rows):
+        panels.append(_panel(i + 1, title, expr, (i // 2) * 8, unit))
+    return {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-cluster",
+        "schemaVersion": 39,
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                }
+            ]
+        },
+        "panels": panels,
+        "time": {"from": "now-30m", "to": "now"},
+        "refresh": "10s",
+    }
+
+
+def prometheus_scrape_config(metrics_addrs: List[str]) -> Dict[str, Any]:
+    """Scrape stanza for every node's /metrics endpoint (the head's state
+    API lists them: node labels carry metrics_addr)."""
+    return {
+        "scrape_configs": [
+            {
+                "job_name": "ray_tpu",
+                "scrape_interval": "10s",
+                "static_configs": [{"targets": metrics_addrs}],
+            }
+        ]
+    }
+
+
+def write_templates(outdir: str, metrics_addrs: List[str] = ()) -> List[str]:
+    import os
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    p = os.path.join(outdir, "grafana_dashboard.json")
+    with open(p, "w") as f:
+        json.dump(grafana_dashboard(), f, indent=1)
+    paths.append(p)
+    p = os.path.join(outdir, "prometheus_scrape.json")
+    with open(p, "w") as f:
+        json.dump(prometheus_scrape_config(list(metrics_addrs) or ["127.0.0.1:0"]), f, indent=1)
+    paths.append(p)
+    return paths
+
+
+if __name__ == "__main__":
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    for p in write_templates(out):
+        print(p)

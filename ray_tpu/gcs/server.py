@@ -174,11 +174,13 @@ class ActorInfo:
         "death_cause",
         "owner_conn_id",
         "direct_addr",
+        "creation_cpu_released",
     )
 
     def __init__(self, spec: TaskSpec):
         self.actor_id = spec.actor_id
         self.state = ACTOR_PENDING
+        self.creation_cpu_released = False
         self.worker_id: Optional[bytes] = None
         self.node_id: Optional[bytes] = None
         self.creation_spec = spec
@@ -214,15 +216,21 @@ class PlacementGroupInfo:
 class TaskEntry:
     """A task known to the scheduler: queued, leased, or running."""
 
-    __slots__ = ("spec", "state", "worker_id", "node_id", "caller_conn_id", "blocked")
+    __slots__ = (
+        "spec", "state", "worker_id", "node_id", "caller_conn_id", "blocked", "wire"
+    )
 
-    def __init__(self, spec: TaskSpec, caller_conn_id: int):
+    def __init__(self, spec: TaskSpec, caller_conn_id: int, wire=None):
         self.spec = spec
         self.state = "QUEUED"
         self.worker_id: Optional[bytes] = None
         self.node_id: Optional[bytes] = None
         self.caller_conn_id = caller_conn_id
         self.blocked = False  # worker released cpu while waiting in get()
+        # the submit frame's wire form, reused verbatim for the PUSH_TASK
+        # dispatch — re-encoding the spec per hop was measurable on the
+        # task hot path
+        self.wire = wire
 
 
 class HeadServer:
@@ -890,6 +898,8 @@ class HeadServer:
             return
         node = self.nodes.get(actor.node_id) if actor.node_id else None
         if node:
+            # a death MID-CREATION still holds the implicit creation CPU
+            self._release_creation_cpu(actor, node, actor.creation_spec)
             node.release(self._actor_lifetime_resources(actor.creation_spec))
         actor.worker_id = None
         actor.node_id = None
@@ -897,6 +907,8 @@ class HeadServer:
         if actor.restarts_used < actor.max_restarts or actor.max_restarts == -1:
             actor.restarts_used += 1
             actor.state = ACTOR_RESTARTING
+            # new incarnation: the re-queued creation acquires CPU afresh
+            actor.creation_cpu_released = False
             spec = actor.creation_spec
             # re-pin exactly like a fresh submit: the restarted creation
             # task's h_task_done will unpin again (without this, restart
@@ -930,6 +942,7 @@ class HeadServer:
             return
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        logger.info("actor %s dead: %s", actor.actor_id.hex()[:8], reason)
         self._record_event("ERROR", "actor", f"actor dead: {reason}", actor_id=actor.actor_id.hex())
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
@@ -959,6 +972,7 @@ class HeadServer:
                     pass
             node = self.nodes.get(actor.node_id) if actor.node_id else None
             if node:
+                self._release_creation_cpu(actor, node, actor.creation_spec)
                 node.release(self._actor_lifetime_resources(actor.creation_spec))
             actor.worker_id = None
         await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_DEAD, "reason": reason})
@@ -1175,28 +1189,50 @@ class HeadServer:
     async def _wait_batch(self, p):
         """Server-side ray.wait: block until num_ready of the ids are
         sealed/errored or the timeout passes (analog: reference
-        WaitManager, src/ray/raylet/wait_manager.cc)."""
+        WaitManager, src/ray/raylet/wait_manager.cc).
+
+        Waiter futures register ONCE per pending oid; each round only
+        counts completions — re-registering per wake made a 10k-ref wait
+        O(N²) in future churn (measured as the 10k-queued drain wall)."""
         oids = [bytes(o) for o in p["object_ids"]]
         want = min(p.get("num_ready", len(oids)), len(oids))
         timeout = p.get("timeout")
         deadline = time.time() + timeout if timeout is not None else None
-        while True:
-            ready = [o for o in oids if self._object_entry(o)[0] != PENDING]
-            if len(ready) >= want or (deadline is not None and time.time() >= deadline):
-                return {"ready": ready}
-            futs = []
-            for o in oids:
-                e = self._object_entry(o)
-                if e[0] == PENDING:
-                    f = asyncio.get_running_loop().create_future()
-                    self.object_waiters.setdefault(o, []).append(f)
-                    futs.append(f)
-            rem = None if deadline is None else max(0.001, deadline - time.time())
-            done, pending = await asyncio.wait(
-                futs, timeout=rem, return_when=asyncio.FIRST_COMPLETED
-            )
-            for f in pending:
-                f.cancel()
+        n_ready = sum(1 for o in oids if self._object_entry(o)[0] != PENDING)
+        registered: List[Tuple[bytes, Any]] = []
+        futs = set()
+        try:
+            if n_ready < want and (deadline is None or time.time() < deadline):
+                loop = asyncio.get_running_loop()
+                for o in oids:
+                    if self._object_entry(o)[0] == PENDING:
+                        f = loop.create_future()
+                        self.object_waiters.setdefault(o, []).append(f)
+                        registered.append((o, f))
+                        futs.add(f)
+                while n_ready < want and futs:
+                    rem = None if deadline is None else max(0.001, deadline - time.time())
+                    if deadline is not None and time.time() >= deadline:
+                        break
+                    done, futs = await asyncio.wait(
+                        futs, timeout=rem, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if not done:
+                        break  # timeout
+                    n_ready += len(done)
+            return {"ready": [o for o in oids if self._object_entry(o)[0] != PENDING]}
+        finally:
+            for o, f in registered:
+                if not f.done():
+                    f.cancel()
+                lst = self.object_waiters.get(o)
+                if lst is not None:
+                    try:
+                        lst.remove(f)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        self.object_waiters.pop(o, None)
 
     def _delete_everywhere(self, oid: bytes):
         """Drop all copies: head store directly, remote nodes by directive
@@ -1452,6 +1488,14 @@ class HeadServer:
 
     # ----------------------------------------------------------------- tasks
 
+    async def h_submit_tasks(self, cid, conn, p):
+        """Batched submit: a driver-side .remote() burst coalesced into one
+        frame (reference analog: the lease-request batching the reference
+        gets from per-scheduling-class lease pipelining)."""
+        for wire in p["specs"]:
+            await self.h_submit_task(cid, conn, {"spec": wire})
+        return {"ok": True}
+
     async def h_submit_task(self, cid, conn, p):
         spec = TaskSpec.from_wire(p["spec"])
         for oid in spec.return_object_ids():
@@ -1475,7 +1519,7 @@ class HeadServer:
                 else:
                     est += 64
             self._record_lineage(spec, est)
-        entry = TaskEntry(spec, cid)
+        entry = TaskEntry(spec, cid, wire=p["spec"])
         self.tasks[spec.task_id] = entry
         self.task_queue.append(entry)
         self._kick_scheduler()
@@ -1552,6 +1596,10 @@ class HeadServer:
                 if w is not None and not w.dedicated:
                     w.idle = True
                     w.idle_since = time.time()
+            if spec.task_type == ACTOR_CREATION_TASK:
+                # default-CPU actors give the creation CPU back once up
+                # (or dead): running actors hold 0 CPU by default
+                self._release_creation_cpu(self.actors.get(spec.actor_id), node, spec)
             if p.get("error") and spec.task_type == ACTOR_CREATION_TASK:
                 actor = self.actors.get(spec.actor_id)
                 if actor:
@@ -1939,7 +1987,10 @@ class HeadServer:
         if not subs:
             return
         dead = []
-        for cid, conn in subs.items():
+        # snapshot: the awaits inside the loop yield to handlers that
+        # subscribe/unsubscribe, which would mutate the dict mid-iteration
+        # (observed as a RuntimeError storm during mass worker death)
+        for cid, conn in list(subs.items()):
             try:
                 await conn.send(MsgType.PUBLISH, {"channel": channel, "message": message})
             except Exception:
@@ -2090,8 +2141,28 @@ class HeadServer:
     def _task_resources(self, spec: TaskSpec) -> Dict[str, float]:
         return spec.resources or {"CPU": 1.0}
 
+    def _release_creation_cpu(self, actor, node, spec: TaskSpec):
+        """Give back the implicit creation CPU exactly once per actor
+        incarnation (at ALIVE, or on death mid-creation — whichever comes
+        first); explicit num_cpus and PG-bundle actors hold theirs."""
+        if not getattr(spec, "implicit_cpu", False) or spec.pg_id or node is None:
+            return
+        if actor is not None:
+            if actor.creation_cpu_released:
+                return
+            actor.creation_cpu_released = True
+        cpu = (spec.resources or {"CPU": 1.0}).get("CPU", 0.0)
+        if cpu > 0:
+            node.release({"CPU": cpu})
+
     def _actor_lifetime_resources(self, spec: TaskSpec) -> Dict[str, float]:
-        return spec.resources or {"CPU": 1.0}
+        """What a LIVE actor holds: its declared resources, minus the
+        creation-only implicit CPU (released at ALIVE; reference
+        semantics: actors default to 0 CPU once running)."""
+        res = dict(spec.resources or {"CPU": 1.0})
+        if getattr(spec, "implicit_cpu", False) and not spec.pg_id:
+            res.pop("CPU", None)
+        return res
 
     def _release_task_resources(self, node: NodeInfo, spec: TaskSpec):
         res = self._task_resources(spec)
@@ -2165,11 +2236,35 @@ class HeadServer:
             return
         remaining: List[TaskEntry] = []
         spawn_demand: Dict[bytes, int] = {}
+        # dispatch-capacity snapshot: idle workers + spawnable slots.  Once
+        # it hits zero NOTHING can dispatch this tick, so stop scanning —
+        # without this a deep backlog (10k+ queued) pays an O(queue) scan
+        # per tick, O(queue²) per drain (measured 140s for a 10k drain).
+        # Counting is conservative (idle TPU workers count as slots for
+        # CPU tasks), which only lengthens the scan, never skips a
+        # dispatchable task.
+        free_slots = 0
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            free_slots += sum(
+                1
+                for w in node.workers.values()
+                if w.idle and w.actor_id is None and not w.dedicated
+            )
+            limit = RayConfig.worker_startup_concurrency or max(
+                2, int(node.resources_total.get("CPU", 2))
+            )
+            headroom = RayConfig.worker_pool_max_workers - len(node.workers)
+            free_slots += max(0, min(headroom, limit) - node.starting_workers)
         # tasks that reserved resources but found no idle worker this tick;
         # reservations are held until the end so demand is capped by what the
         # node can actually run simultaneously (not by queue length)
         unfulfilled: List[Tuple[TaskEntry, NodeInfo]] = []
-        for entry in self.task_queue:
+        for i, entry in enumerate(self.task_queue):
+            if free_slots <= 0:
+                remaining.extend(self.task_queue[i:])
+                break
             spec = entry.spec
             node = self._pick_node(spec)
             if node is None:
@@ -2184,8 +2279,10 @@ class HeadServer:
                 spawn_demand[key] = spawn_demand.get(key, 0) + 1
                 unfulfilled.append((entry, node))
                 remaining.append(entry)
+                free_slots -= 1  # consumed a spawn slot
                 continue
             await self._dispatch(entry, node, worker)
+            free_slots -= 1
         for entry, node in unfulfilled:
             self._release_task_resources(node, entry.spec)
         self.task_queue = remaining
@@ -2208,8 +2305,15 @@ class HeadServer:
     def _maybe_spawn_worker(self, node: NodeInfo, demand: int = 1, tpu: bool = False):
         """Spawn workers up to current demand — the startup-token discipline
         of the reference's WorkerPool (worker_pool.cc:218
-        StartWorkerProcess + MonitorStartingWorkerProcess:485)."""
-        while node.starting_workers < demand:
+        StartWorkerProcess + MonitorStartingWorkerProcess:485).  Concurrent
+        STARTS are capped at ~#CPUs (reference maximum_startup_concurrency):
+        an uncapped 25-way python-import storm on a small host starves the
+        running workers' heartbeats; the pending demand drains across ticks
+        as registrations free tokens."""
+        startup_limit = RayConfig.worker_startup_concurrency or max(
+            2, int(node.resources_total.get("CPU", 2))
+        )
+        while node.starting_workers < min(demand, startup_limit):
             pool_size = len(node.workers) + node.starting_workers
             if pool_size >= RayConfig.worker_pool_max_workers:
                 return
@@ -2263,7 +2367,14 @@ class HeadServer:
                 actor.worker_id = worker.worker_id
                 actor.node_id = node.node_id
         try:
-            await worker.conn.send(MsgType.PUSH_TASK, {"spec": spec.to_wire()})
+            # PG tasks re-encode: _pick_node may have just assigned the
+            # bundle index, which the cached submit wire wouldn't carry
+            wire = (
+                entry.wire
+                if entry.wire is not None and not spec.pg_id
+                else spec.to_wire()
+            )
+            await worker.conn.send(MsgType.PUSH_TASK, {"spec": wire})
         except Exception:
             await self._on_worker_dead(worker.worker_id, "push failed")
 
@@ -2367,6 +2478,7 @@ HeadServer._HANDLERS = {
     MsgType.LIST_OBJECTS: HeadServer.h_list_objects,
     MsgType.LIST_EVENTS: HeadServer.h_list_events,
     MsgType.RECORD_EVENT: HeadServer.h_record_event,
+    MsgType.SUBMIT_TASKS: HeadServer.h_submit_tasks,
     MsgType.CLIENT_PUT: HeadServer.h_client_put,
     MsgType.CLIENT_GET: HeadServer.h_client_get,
     MsgType.KV_PUT: HeadServer.h_kv_put,

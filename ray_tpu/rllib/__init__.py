@@ -9,6 +9,13 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_vector_env,
 )
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401
+from ray_tpu.rllib.policy_server import PolicyClient, PolicyServer  # noqa: F401
 from ray_tpu.rllib.models import CNNModel, MLPModel, get_model  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
 from ray_tpu.rllib.replay_buffer import (  # noqa: F401

@@ -1,0 +1,129 @@
+"""Declarative Serve config schema + apply.
+
+Analog of the reference's serve schema/REST surface (reference:
+python/ray/serve/schema.py ServeApplicationSchema — deployments declared
+as data, applied idempotently; served over the dashboard REST API,
+dashboard/modules/serve/).  Deployment callables are referenced by
+``import_path`` ("pkg.module:attr"), so a config file fully describes an
+application.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class DeploymentSchema:
+    name: str
+    import_path: str  # "module.sub:attr" resolving to a @serve.deployment
+    num_replicas: int = 1
+    route_prefix: Optional[str] = None
+    max_concurrent_queries: int = 100
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    init_args: List[Any] = field(default_factory=list)
+    # keys the config actually SET — apply() only overrides these, so a
+    # decorator-declared route_prefix/num_replicas survives a config that
+    # omits them
+    present: frozenset = frozenset()
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DeploymentSchema":
+        if "user_config" in d:
+            raise ValueError(
+                "user_config is not supported yet (replica reconfigure is "
+                "not wired through the declarative path)"
+            )
+        known = {f for f in DeploymentSchema.__dataclass_fields__} - {"present"}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown deployment config keys: {sorted(extra)}")
+        if "name" not in d or "import_path" not in d:
+            raise ValueError("deployment config needs 'name' and 'import_path'")
+        return DeploymentSchema(**d, present=frozenset(d))
+
+
+@dataclass
+class ServeApplicationSchema:
+    deployments: List[DeploymentSchema] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ServeApplicationSchema":
+        deps = d.get("deployments")
+        if not isinstance(deps, list) or not deps:
+            raise ValueError("config needs a non-empty 'deployments' list")
+        return ServeApplicationSchema(
+            deployments=[DeploymentSchema.from_dict(x) for x in deps]
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "deployments": [
+                {
+                    "name": s.name,
+                    "import_path": s.import_path,
+                    "num_replicas": s.num_replicas,
+                    "route_prefix": s.route_prefix,
+                    "max_concurrent_queries": s.max_concurrent_queries,
+                    "autoscaling_config": s.autoscaling_config,
+                    "init_args": s.init_args,
+                    "user_config": s.user_config,
+                }
+                for s in self.deployments
+            ]
+        }
+
+
+def _resolve_import_path(path: str):
+    mod_name, _, attr = path.partition(":")
+    if not attr:
+        raise ValueError(f"import_path must be 'module:attr', got {path!r}")
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def apply(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply a declarative application config: every listed deployment is
+    (re)deployed to its declared goal state (idempotent — the controller's
+    version gate skips unchanged definitions)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.api import Deployment
+
+    schema = ServeApplicationSchema.from_dict(config)
+    applied = []
+    for d in schema.deployments:
+        target = _resolve_import_path(d.import_path)
+        opts = {"name": d.name}
+        for key in (
+            "num_replicas",
+            "route_prefix",
+            "max_concurrent_queries",
+            "autoscaling_config",
+        ):
+            if key in d.present:
+                opts[key] = getattr(d, key)
+        if isinstance(target, Deployment):
+            dep = target.options(**opts)
+        else:
+            dep = serve.deployment(target, **opts)
+        if "init_args" in d.present:
+            dep = dep.bind(*d.init_args)
+        elif isinstance(target, Deployment):
+            dep = dep  # keep the decorator-bound args
+        else:
+            dep = dep.bind()
+        serve.run(dep)
+        applied.append(d.name)
+    return {"applied": applied}
+
+
+def status() -> Dict[str, Any]:
+    """Current application state (reference: serve status REST)."""
+    from ray_tpu import serve
+
+    return {"deployments": serve.list_deployments()}

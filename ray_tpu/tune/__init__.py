@@ -6,6 +6,8 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

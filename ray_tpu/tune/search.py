@@ -78,6 +78,99 @@ def grid_search(values) -> GridSearch:
     return GridSearch(values)
 
 
+class Searcher:
+    """Iterative suggestion protocol (reference: tune/search/searcher.py
+    Searcher — suggest per trial, learn from completed results; the shape
+    hyperopt/optuna integrations plug into)."""
+
+    def set_search_properties(self, metric: str, mode: str, param_space: Dict[str, Any]):
+        raise NotImplementedError
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, metrics: Dict[str, Any]):
+        pass
+
+
+class TPESearcher(Searcher):
+    """Native model-based searcher (no external deps in the image):
+    Tree-structured-Parzen-style — after ``n_startup`` random trials,
+    sample candidates and keep the one most likely under the good-trial
+    kernel density vs the rest (reference analog:
+    tune/search/hyperopt/hyperopt_search.py:50, whose backend is TPE)."""
+
+    def __init__(self, n_startup: int = 8, n_candidates: int = 24, gamma: float = 0.25, seed: int = 0):
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.rng = random.Random(seed)
+        self.metric = "loss"
+        self.mode = "min"
+        self.space: Dict[str, Any] = {}
+        self._results: List[tuple] = []  # (score, config)
+
+    def set_search_properties(self, metric, mode, param_space):
+        self.metric, self.mode, self.space = metric, mode, dict(param_space)
+
+    def _random_config(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _numeric_keys(self) -> List[str]:
+        return [
+            k
+            for k, v in self.space.items()
+            if isinstance(v, (Uniform, LogUniform, Randint))
+        ]
+
+    def _density(self, cfg, group) -> float:
+        """Product of per-dim Gaussian KDEs over the group's configs."""
+        import math
+
+        keys = self._numeric_keys()
+        if not group or not keys:
+            return 1.0
+        logp = 0.0
+        for k in keys:
+            vals = [float(c[k]) for _, c in group]
+            x = float(cfg[k])
+            spread = max((max(vals) - min(vals)) / 2.0, 1e-9)
+            p = sum(
+                math.exp(-(((x - v) / spread) ** 2) / 2.0) for v in vals
+            ) / (len(vals) * spread)
+            logp += math.log(max(p, 1e-12))
+        return logp
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if len(self._results) < self.n_startup:
+            return self._random_config()
+        ordered = sorted(
+            self._results, key=lambda t: t[0], reverse=(self.mode == "max")
+        )
+        n_good = max(1, int(len(ordered) * self.gamma))
+        good, rest = ordered[:n_good], ordered[n_good:]
+        best_cfg, best_score = None, -float("inf")
+        for _ in range(self.n_candidates):
+            cand = self._random_config()
+            score = self._density(cand, good) - self._density(cand, rest)
+            if score > best_score:
+                best_cfg, best_score = cand, score
+        return best_cfg
+
+    def on_trial_complete(self, trial_id: str, metrics: Dict[str, Any]):
+        if self.metric in metrics:
+            # remember the config actually run (numeric keys only needed)
+            self._results.append((float(metrics[self.metric]), dict(metrics.get("config") or {})))
+
+
 def generate_variants(
     param_space: Dict[str, Any], num_samples: int, seed: int = 0
 ) -> List[Dict[str, Any]]:

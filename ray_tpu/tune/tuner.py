@@ -27,6 +27,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # iterative Searcher (tune/search.py, e.g. TPESearcher): suggests each
+    # trial's config from completed results instead of upfront sampling
+    searcher: Any = None
     seed: int = 0
 
 
@@ -186,9 +189,20 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
+        searcher = tc.searcher
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode, self.param_space)
         if self._restored_trials is not None:
             trials = self._restored_trials
             pending = [t for t in trials if t.state == "PENDING"]
+        elif searcher is not None:
+            # iterative search: configs are SUGGESTED as trials start, so
+            # later trials learn from earlier completions
+            trials = [
+                Trial(trial_id=f"trial_{i:05d}", config={})
+                for i in range(tc.num_samples)
+            ]
+            pending = list(trials)
         else:
             variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
             trials = [
@@ -207,6 +221,10 @@ class Tuner:
                 scheduler.on_trial_add(t.trial_id, t.config)
 
         def _start_trial(trial: Trial, checkpoint=None):
+            if searcher is not None and not trial.config:
+                trial.config = searcher.suggest(trial.trial_id)
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(trial.trial_id, trial.config)
             trial.actor = actor_cls.options(
                 num_cpus=self.resources_per_trial.get("CPU", 1),
                 resources={
@@ -259,6 +277,11 @@ class Tuner:
                         )
                 elif kind == "done":
                     trial.state = "TERMINATED"
+                    if searcher is not None and trial.last_metrics:
+                        searcher.on_trial_complete(
+                            trial.trial_id,
+                            {**trial.last_metrics, "config": trial.config},
+                        )
                     ray_tpu.kill(trial.actor)
                     running.remove(trial)
                 elif kind == "error":

@@ -1,0 +1,138 @@
+"""Dask-on-ray_tpu scheduler shim.
+
+Analog of the reference's dask scheduler (reference:
+python/ray/util/dask/scheduler.py:83 ray_dask_get — plugs into
+``dask.compute(..., scheduler=ray_dask_get)``): every dask-graph task
+becomes a ray task, graph edges become ObjectRef arguments, so the
+object store deduplicates shared intermediates and independent branches
+run in parallel.
+
+The scheduler operates on the plain dask graph protocol (a dict of
+``key -> (callable, *args)`` with keys referencing other entries), so it
+works — and is tested — without dask installed; with dask installed,
+pass it as ``scheduler=ray_dask_get``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+from ray_tpu._private.object_ref import ObjectRef
+
+
+def _is_task(x) -> bool:
+    return isinstance(x, tuple) and x and callable(x[0])
+
+
+def _is_key(x, dsk) -> bool:
+    return isinstance(x, Hashable) and not _is_task(x) and x in dsk
+
+
+@ray_tpu.remote
+def _exec_node(desc, *dep_values):
+    """Evaluate one graph node IN THE WORKER.  desc is a nested descriptor
+    tree; ("dep", i) references dep_values[i] — upstream ObjectRefs passed
+    as task args, already materialized by the runtime.  Composite (nested
+    tuple) tasks therefore run in their parent's ray task, not on the
+    driver, and submission never blocks."""
+
+    def ev(d):
+        kind = d[0]
+        if kind == "lit":
+            return d[1]
+        if kind == "dep":
+            return dep_values[d[1]]
+        if kind == "task":
+            fn, parts = d[1], d[2]
+            return fn(*[ev(p) for p in parts])
+        if kind == "list":
+            return [ev(x) for x in d[1]]
+        raise ValueError(f"bad descriptor {d[0]!r}")
+
+    return ev(desc)
+
+
+def _build_descriptor(a, dsk, computed, deps: List[Any]):
+    """Graph-arg → (descriptor, refs-appended-to-deps): keys become dep
+    slots filled with their node's ObjectRef; nested task tuples become
+    task descriptors evaluated in the worker; lists recurse."""
+    try:
+        if _is_key(a, dsk):
+            v = computed[a]
+            deps.append(v)
+            return ("dep", len(deps) - 1)
+    except TypeError:
+        pass  # unhashable (list/dict args)
+    if _is_task(a):
+        fn, *rest = a
+        return ("task", fn, [_build_descriptor(r, dsk, computed, deps) for r in rest])
+    if isinstance(a, list):
+        return ("list", [_build_descriptor(x, dsk, computed, deps) for x in a])
+    return ("lit", a)
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_kwargs):
+    """Execute a dask graph on the cluster; returns values for `keys`
+    (nested key lists mirror dask's collection structure)."""
+    # topological order via DFS
+    order: List[Hashable] = []
+    seen: set = set()
+
+    def deps_of(v, out):
+        if _is_task(v):
+            for a in v[1:]:
+                deps_of(a, out)
+        elif isinstance(v, list):
+            for a in v:
+                deps_of(a, out)
+        else:
+            try:
+                if _is_key(v, dsk):
+                    out.append(v)
+            except TypeError:
+                pass
+
+    def visit(k, stack=()):
+        if k in seen:
+            return
+        if k in stack:
+            raise ValueError(f"cycle in dask graph at {k!r}")
+        deps: List[Hashable] = []
+        deps_of(dsk[k], deps)
+        for d in deps:
+            visit(d, stack + (k,))
+        seen.add(k)
+        order.append(k)
+
+    def flat_keys(ks):
+        for k in ks if isinstance(ks, (list, tuple)) else [ks]:
+            if isinstance(k, list):
+                yield from flat_keys(k)
+            else:
+                yield k
+
+    for k in flat_keys(keys):
+        visit(k)
+
+    computed: Dict[Hashable, Any] = {}
+    for k in order:
+        node = dsk[k]
+        if _is_task(node):
+            deps: List[Any] = []
+            desc = _build_descriptor(node, dsk, computed, deps)
+            computed[k] = _exec_node.remote(desc, *deps)
+        elif _is_key(node, dsk):
+            computed[k] = computed[node]  # alias
+        else:
+            computed[k] = ray_tpu.put(node)  # literal
+
+    def gather(ks):
+        if isinstance(ks, list):
+            return [gather(k) for k in ks]
+        v = computed[ks]
+        return ray_tpu.get(v, timeout=600) if isinstance(v, ObjectRef) else v
+
+    if isinstance(keys, list):
+        return [gather(k) for k in keys]
+    return gather(keys)

@@ -1,1 +1,15 @@
-from ray_tpu.workflow.api import get_status, resume, run, run_async, step  # noqa: F401
+from ray_tpu.workflow.api import (  # noqa: F401
+    get_status,
+    resume,
+    run,
+    run_async,
+    set_storage,
+    step,
+    virtual_actor,
+    wait_for_event,
+)
+from ray_tpu.workflow.storage import (  # noqa: F401
+    FilesystemStorage,
+    KVStorage,
+    WorkflowStorage,
+)

@@ -1,27 +1,48 @@
-"""Workflow: durable task DAGs with storage-backed resume.
+"""Workflow: durable task DAGs with storage-backed resume, event steps,
+and virtual actors.
 
 Analog of the reference's ray.workflow (reference: python/ray/workflow/
-api.py run/resume, task_executor.py, storage/ — every step's result is
+api.py run/resume + wait_for_event, task_executor.py, workflow_access.py
+virtual-actor management, storage/ backends — every step's result is
 persisted so a crashed workflow resumes from completed steps).
 
-Steps are normal remote tasks; results checkpoint to a filesystem store
-keyed by (workflow_id, step_name).  `resume` re-runs the DAG — steps whose
-checkpoint exists return it without executing.
+Steps are normal remote tasks; results checkpoint through a pluggable
+``WorkflowStorage`` (filesystem default; ``KVStorage`` rides the GCS WAL
+for head-restart durability).  Event steps poll an external condition
+and checkpoint its payload, so a resume never re-waits a received event.
+Virtual actors persist their state per method call and revive on demand
+from storage.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
-import pickle
+import time
 from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.workflow.storage import FilesystemStorage, KVStorage, WorkflowStorage
 
 STORAGE_ENV = "RAY_TPU_WORKFLOW_STORAGE"
 _DEFAULT_STORAGE = "/tmp/ray_tpu/workflows"
 
+_storage: Optional[WorkflowStorage] = None
 
-def _storage_dir() -> str:
-    return os.environ.get(STORAGE_ENV, _DEFAULT_STORAGE)
+
+def set_storage(storage: Optional[WorkflowStorage]):
+    """Install a storage backend ("kv" durability vs filesystem); None
+    resets to the env-configured filesystem default."""
+    global _storage
+    _storage = storage
+
+
+def _get_storage() -> WorkflowStorage:
+    if _storage is not None:
+        return _storage
+    root = os.environ.get(STORAGE_ENV, _DEFAULT_STORAGE)
+    if root == "kv":
+        return KVStorage()
+    return FilesystemStorage(root)
 
 
 class WorkflowStep:
@@ -42,6 +63,18 @@ class WorkflowStep:
         return hashlib.sha1(path.encode()).hexdigest()[:16]
 
 
+class EventStep(WorkflowStep):
+    """A step that WAITS: polls `poll_fn` until it returns non-None, then
+    checkpoints the payload (reference analog: workflow.wait_for_event +
+    event_listener.py — resume never re-waits a received event)."""
+
+    def __init__(self, poll_fn: Callable[[], Any], name: Optional[str] = None,
+                 timeout: Optional[float] = None, poll_interval: float = 0.5):
+        super().__init__(poll_fn, (), {}, name or f"event_{poll_fn.__name__}")
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+
 def step(fn: Callable) -> Callable:
     """@workflow.step decorator: calling the function builds a DAG node."""
 
@@ -53,37 +86,49 @@ def step(fn: Callable) -> Callable:
     return bind
 
 
-def _ckpt_path(workflow_id: str, step_key: str) -> str:
-    return os.path.join(_storage_dir(), workflow_id, f"{step_key}.pkl")
+def wait_for_event(poll_fn: Callable[[], Any], *, timeout: Optional[float] = None,
+                   poll_interval: float = 0.5, name: Optional[str] = None) -> EventStep:
+    """Build an event step: resolves to poll_fn()'s first non-None value."""
+    return EventStep(poll_fn, name=name, timeout=timeout, poll_interval=poll_interval)
 
 
-def _execute(node: Any, workflow_id: str, path: str) -> Any:
+def _execute(node: Any, workflow_id: str, path: str, storage: WorkflowStorage) -> Any:
     if not isinstance(node, WorkflowStep):
         return node
-    key = node._step_key(path)
-    ckpt = _ckpt_path(workflow_id, key)
-    if os.path.exists(ckpt):
-        with open(ckpt, "rb") as f:
-            return pickle.load(f)
+    key = f"{workflow_id}/steps/{node._step_key(path)}"
+    if storage.exists(key):
+        return storage.get(key)
+    # legacy layout (pre-r4): step checkpoints lived at <wf>/<key>.pkl —
+    # honor them so old workflows keep resuming after the storage refactor
+    legacy = f"{workflow_id}/{node._step_key(path)}"
+    if isinstance(storage, FilesystemStorage) and storage.exists(legacy):
+        return storage.get(legacy)
+    if isinstance(node, EventStep):
+        deadline = time.time() + node.timeout if node.timeout is not None else None
+        while True:
+            payload = node.fn()
+            if payload is not None:
+                break
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"event step {node.name} timed out")
+            time.sleep(node.poll_interval)
+        storage.put(key, payload)
+        return payload
     # resolve upstream steps depth-first (sequential; parallel fanout via
     # sibling steps resolving to independent tasks would go through wait)
     args = [
-        _execute(a, workflow_id, f"{path}/arg{i}:{getattr(a, 'name', '')}")
+        _execute(a, workflow_id, f"{path}/arg{i}:{getattr(a, 'name', '')}", storage)
         for i, a in enumerate(node.args)
     ]
     kwargs = {
-        k: _execute(v, workflow_id, f"{path}/kw_{k}:{getattr(v, 'name', '')}")
+        k: _execute(v, workflow_id, f"{path}/kw_{k}:{getattr(v, 'name', '')}", storage)
         for k, v in node.kwargs.items()
     }
     import ray_tpu
 
     remote_fn = ray_tpu.remote(node.fn)
     result = ray_tpu.get(remote_fn.remote(*args, **kwargs), timeout=600)
-    os.makedirs(os.path.dirname(ckpt), exist_ok=True)
-    tmp = ckpt + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(result, f)
-    os.replace(tmp, ckpt)
+    storage.put(key, result)
     return result
 
 
@@ -93,18 +138,14 @@ def run(dag: WorkflowStep, workflow_id: Optional[str] = None) -> Any:
     import uuid
 
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:8]}"
-    wf_dir = os.path.join(_storage_dir(), workflow_id)
-    os.makedirs(wf_dir, exist_ok=True)
-    with open(os.path.join(wf_dir, "STATUS"), "w") as f:
-        f.write("RUNNING")
+    storage = _get_storage()
+    storage.put(f"{workflow_id}/STATUS", "RUNNING")
     try:
-        result = _execute(dag, workflow_id, dag.name)
-        with open(os.path.join(wf_dir, "STATUS"), "w") as f:
-            f.write("SUCCESSFUL")
+        result = _execute(dag, workflow_id, dag.name, storage)
+        storage.put(f"{workflow_id}/STATUS", "SUCCESSFUL")
         return result
     except BaseException:
-        with open(os.path.join(wf_dir, "STATUS"), "w") as f:
-            f.write("FAILED")
+        storage.put(f"{workflow_id}/STATUS", "FAILED")
         raise
 
 
@@ -128,8 +169,74 @@ def resume(workflow_id: str, dag: WorkflowStep) -> Any:
 
 
 def get_status(workflow_id: str) -> str:
-    try:
-        with open(os.path.join(_storage_dir(), workflow_id, "STATUS")) as f:
-            return f.read().strip()
-    except OSError:
-        return "NOT_FOUND"
+    storage = _get_storage()
+    status = storage.get(f"{workflow_id}/STATUS")
+    if status is not None:
+        return status
+    # legacy layout: STATUS was a plain-text file
+    if isinstance(storage, FilesystemStorage):
+        try:
+            with open(os.path.join(storage.root, workflow_id, "STATUS")) as f:
+                return f.read().strip()
+        except OSError:
+            pass
+    return "NOT_FOUND"
+
+
+# ------------------------------------------------------------ virtual actors
+
+
+class VirtualActorHandle:
+    """Durable actor facade: state lives in workflow storage, methods run
+    as ray tasks over (state, args) → (new_state, result), each call
+    persisted — the actor 'exists' only as its stored state and revives
+    anywhere (reference: workflow_access.py virtual actors)."""
+
+    def __init__(self, cls, actor_id: str, storage: WorkflowStorage):
+        self._cls = cls
+        self._actor_id = actor_id
+        self._storage = storage
+
+    def _state_key(self) -> str:
+        return f"virtual_actors/{self._cls.__name__}/{self._actor_id}/state"
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        cls = self._cls
+        storage = self._storage
+        key = self._state_key()
+
+        def call(*args, **kwargs):
+            import ray_tpu
+
+            state = storage.get(key)
+
+            def run_method(state_dict, m=method_name):
+                obj = cls.__new__(cls)
+                obj.__dict__.update(state_dict)
+                out = getattr(obj, m)(*args, **kwargs)
+                return obj.__dict__, out
+
+            fn = ray_tpu.remote(run_method)
+            new_state, result = ray_tpu.get(fn.remote(state), timeout=600)
+            storage.put(key, new_state)
+            return result
+
+        return call
+
+
+def virtual_actor(cls):
+    """@workflow.virtual_actor class decorator."""
+
+    def get_or_create(actor_id: str, *args, **kwargs) -> VirtualActorHandle:
+        storage = _get_storage()
+        handle = VirtualActorHandle(cls, actor_id, storage)
+        key = handle._state_key()
+        if not storage.exists(key):
+            obj = cls(*args, **kwargs)
+            storage.put(key, obj.__dict__)
+        return handle
+
+    cls.get_or_create = staticmethod(get_or_create)
+    return cls

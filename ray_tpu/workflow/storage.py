@@ -1,0 +1,95 @@
+"""Workflow storage backends.
+
+Analog of the reference's pluggable workflow storage (reference:
+python/ray/workflow/storage/ — filesystem and S3 implementations behind
+one interface).  Two backends here: the filesystem (default) and the
+cluster KV — the latter rides the GCS WAL, so workflow progress survives
+head restarts with no shared filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+
+class WorkflowStorage:
+    """Key-value-with-prefix-listing interface for workflow state."""
+
+    def put(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, default: Any = None) -> Any:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class FilesystemStorage(WorkflowStorage):
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/")) + ".pkl"
+
+    def put(self, key: str, value: Any) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            with open(self._path(key), "rb") as f:
+                return pickle.load(f)
+        except OSError:
+            return default
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        base = os.path.join(self.root, *prefix.split("/"))
+        out = []
+        if os.path.isdir(base):
+            for root, _dirs, files in os.walk(base):
+                for f in files:
+                    if f.endswith(".pkl"):
+                        rel = os.path.relpath(os.path.join(root, f[:-4]), self.root)
+                        out.append(rel.replace(os.sep, "/"))
+        return out
+
+
+class KVStorage(WorkflowStorage):
+    """Workflow state in the head KV (persisted by the GCS WAL): durable
+    across head restarts without any shared filesystem."""
+
+    PREFIX = "wf:"
+
+    def _core(self):
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod._require_connected()
+
+    def put(self, key: str, value: Any) -> None:
+        self._core().kv_put(self.PREFIX + key, pickle.dumps(value))
+
+    def get(self, key: str, default: Any = None) -> Any:
+        blob = self._core().kv_get(self.PREFIX + key)
+        if not blob:
+            return default
+        return pickle.loads(blob)
+
+    def exists(self, key: str) -> bool:
+        return self._core().kv_get(self.PREFIX + key) is not None
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        keys = self._core().kv_keys(self.PREFIX + prefix)
+        return [k[len(self.PREFIX):] for k in keys]

@@ -248,3 +248,40 @@ def test_controller_recovery_after_kill(ray_cluster):
     # the replica actor itself survived: counter continues, not restarts
     handle2 = serve.get_deployment_handle("counter")
     assert ray_tpu.get(handle2.remote(), timeout=60) == 3
+
+
+# module-level deployment target for the declarative-config test (the
+# schema resolves it by import path)
+@serve.deployment(name="echo_from_schema")
+def _echo_for_schema(x):
+    return {"echo": x}
+
+
+def test_declarative_schema_apply_and_rest(ray_cluster):
+    """Declarative config → deployment (reference: serve/schema.py +
+    the serve REST API on the dashboard)."""
+    from ray_tpu.serve import schema as serve_schema
+
+    cfg = {
+        "deployments": [
+            {
+                "name": "echo_from_schema",
+                "import_path": "tests.test_serve:_echo_for_schema",
+                "num_replicas": 2,
+            }
+        ]
+    }
+    out = serve_schema.apply(cfg)
+    assert out["applied"] == ["echo_from_schema"]
+    deps = serve.list_deployments()
+    assert deps["echo_from_schema"]["target"] == 2
+    handle = serve.get_deployment_handle("echo_from_schema")
+    assert ray_tpu.get(handle.remote(3), timeout=120) == {"echo": 3}
+
+    # schema validation rejects junk
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        serve_schema.ServeApplicationSchema.from_dict({"deployments": []})
+    with _pytest.raises(ValueError):
+        serve_schema.DeploymentSchema.from_dict({"name": "x", "import_path": "a:b", "bogus": 1})

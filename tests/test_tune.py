@@ -163,3 +163,35 @@ def test_pbt_exploits_and_improves(ray_start_regular):
     # exploiting the lr=1.0 trial's checkpoint should push best score well
     # beyond what lr=0.01 alone reaches (12*0.01=0.12)
     assert best.metrics["score"] > 1.0
+
+
+def test_tpe_searcher_concentrates(ray_cluster):
+    """Native TPE-style searcher: later suggestions concentrate near the
+    optimum of a quadratic (reference analog: hyperopt_search.py TPE)."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search import TPESearcher
+    from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+    def objective(config):
+        from ray_tpu.air import session
+
+        x = config["x"]
+        session.report({"loss": (x - 3.0) ** 2})
+
+    searcher = TPESearcher(n_startup=6, seed=0)
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=24,
+            max_concurrent_trials=2, searcher=searcher,
+        ),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 1.5, best.metrics
+    # the last suggestions should sit closer to x=3 than the startup draws
+    xs = [t.config["x"] for t in grid.trials]
+    startup_err = sum(abs(x - 3.0) for x in xs[:6]) / 6
+    late_err = sum(abs(x - 3.0) for x in xs[-6:]) / 6
+    assert late_err < startup_err, (startup_err, late_err)

@@ -178,8 +178,18 @@ def test_list_objects_state_api(ray_start_regular):
 
     ref = ray_tpu.put(np.ones(1000))
     _ = ray_tpu.get(ref, timeout=30)
-    rows = list_objects()
-    mine = [r for r in rows if r["object_id"] == ref.binary().hex()]
+    # local refs flush to the head in batches (~0.2s cadence), so the
+    # state API's ref_count view is eventually consistent — poll briefly
+    import time as _t
+
+    deadline = _t.time() + 5
+    mine = []
+    while _t.time() < deadline:
+        rows = list_objects()
+        mine = [r for r in rows if r["object_id"] == ref.binary().hex()]
+        if mine and mine[0]["ref_count"] >= 1:
+            break
+        _t.sleep(0.1)
     assert mine and mine[0]["state"] == "SEALED"
     assert mine[0]["ref_count"] >= 1
     assert mine[0]["locations"], "no location recorded"
