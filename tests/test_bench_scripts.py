@@ -1,0 +1,38 @@
+"""The driver runs bench.py at round end and the judge reads the bench
+artifacts — an import-time regression in any bench script must surface
+in CI, not at round end."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import(name):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(REPO, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_scripts_import():
+    for name in ("bench", "bench_rllib", "bench_serve"):
+        mod = _import(name)
+        assert hasattr(mod, "main")
+
+
+def test_graft_entry_helpers():
+    mod = _import("__graft_entry__")
+    # the static env probe must not touch jax
+    assert mod._cpu_mesh_ready({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 8)
+    assert not mod._cpu_mesh_ready({"PALLAS_AXON_POOL_IPS": "x"}, 8)
+    dp, fsdp, tp, sp = mod._axes_for(8)
+    assert dp * fsdp * tp * sp == 8
+
+
+def test_bench_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("BENCH_MODEL", "gpt2_350m")
+    monkeypatch.setenv("BENCH_BATCH", "4")
+    mod = _import("bench")
+    cfg = mod._bench_config()
+    assert cfg["model"] == "gpt2_350m" and cfg["batch"] == 4
