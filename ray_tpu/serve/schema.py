@@ -69,7 +69,6 @@ class ServeApplicationSchema:
                     "max_concurrent_queries": s.max_concurrent_queries,
                     "autoscaling_config": s.autoscaling_config,
                     "init_args": s.init_args,
-                    "user_config": s.user_config,
                 }
                 for s in self.deployments
             ]

@@ -2,6 +2,7 @@
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -99,3 +100,65 @@ def test_per_node_metrics_endpoints(shutdown_only):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# module-level target for the REST declarative deploy test
+def _rest_echo(x):
+    return {"rest": x}
+
+
+def test_serve_rest_api(ray_cluster):
+    """PUT a declarative app config over HTTP, then GET its status
+    (reference: the dashboard serve module REST API)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    url = start_dashboard(port=18266)
+
+    cfg = {
+        "deployments": [
+            {
+                "name": "rest_echo",
+                "import_path": "tests.test_dashboard:_rest_echo",
+                "num_replicas": 1,
+            }
+        ]
+    }
+    body = json.dumps(cfg).encode()
+
+    def put(path, data):
+        req = urllib.request.Request(
+            url + path, data=data, method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        deadline = time.time() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                raise
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    out = put("/api/serve/applications", body)
+    assert out == {"applied": ["rest_echo"]}
+
+    with urllib.request.urlopen(url + "/api/serve/applications", timeout=30) as r:
+        status = json.loads(r.read())
+    assert "rest_echo" in status["deployments"]
+
+    # the deployed app actually serves
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle("rest_echo")
+    assert ray_tpu.get(handle.remote(5), timeout=120) == {"rest": 5}
+
+    # bad config -> 400, not a crash
+    bad = json.dumps({"deployments": [{"name": "x"}]}).encode()
+    try:
+        put("/api/serve/applications", bad)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
