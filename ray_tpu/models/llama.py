@@ -169,12 +169,24 @@ class LlamaModel:
 
         new_cache = None
         if kv_cache is not None:
-            ck, cv = kv_cache  # [B, max_seq, KV, D]
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
-            k, v = ck, cv
-            new_cache = (ck, cv)
-            kv_len = ck.shape[1]
+            # decode: the FULL [L, B, max_seq, KV, D] cache rides through —
+            # the write is ONE token-sized dynamic_update_slice (25KB), not
+            # a rewrite of this layer's whole slice, so XLA keeps the scan
+            # carry in place and per-step HBM traffic is reads-only
+            # (weights + cache).  Rewriting per-layer slices through a
+            # layer-scan's stacked outputs measured 4-5x slower.
+            ck_all, cv_all, li = kv_cache
+            ck_all = jax.lax.dynamic_update_slice(
+                ck_all, k[None].astype(ck_all.dtype), (li, 0, cache_index, 0, 0)
+            )
+            cv_all = jax.lax.dynamic_update_slice(
+                cv_all, v[None].astype(cv_all.dtype), (li, 0, cache_index, 0, 0)
+            )
+            # li is a static python int (unrolled layer loop)
+            k = ck_all[li]
+            v = cv_all[li]
+            new_cache = (ck_all, cv_all)
+            kv_len = k.shape[1]
             kv_pos = jnp.arange(kv_len)
             mask = kv_pos[None, :] <= positions[:, None]  # [S(q), kv_len]
         else:
@@ -260,17 +272,16 @@ class LlamaModel:
         positions = jnp.reshape(positions, (1,))
 
         ck_all, cv_all = cache
-        new_k, new_v = [], []
-
-        def body(carry, inputs):
-            x = carry
-            lp, ck, cv = inputs
-            y, new_cache = self._layer(x, lp, positions, kv_cache=(ck, cv), cache_index=position)
-            return y, new_cache
-
-        x, (ck_out, cv_out) = jax.lax.scan(
-            body, x, (params["layers"], ck_all, cv_all)
-        )
+        # python loop over layers (unrolled, static layer index): each
+        # layer's cache update is a single token-sized in-place write into
+        # the full 5-D cache.  A lax.scan over layers would route the cache
+        # through stacked scan OUTPUTS, rewriting all L x [B,S,KV,D] slices
+        # every step — measured 63.8ms/step at B=16 vs ~15ms unrolled
+        for li in range(cfg.n_layers):
+            lp = jax.tree.map(lambda p: p[li], params["layers"])
+            x, (ck_all, cv_all) = self._layer(
+                x, lp, positions, kv_cache=(ck_all, cv_all, li), cache_index=position
+            )
         x = _rms_norm(x, params["final_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
         logits = (x @ params["out_head"].astype(cd))[:, 0, :]
-        return logits, (ck_out, cv_out)
+        return logits, (ck_all, cv_all)
