@@ -637,17 +637,24 @@ class CoreWorker:
             # waiter, so already-sealed head-path objects can satisfy
             # num_returns while direct calls are still in flight (sequencing
             # direct-then-head would block past ready objects — ADVICE r3)
-            head_state: Dict[str, Any] = {}
+            head_state: Dict[str, Any] = {"gen": 0}
             head_fut = None
 
-            def _on_head(f):
+            def _on_head(f, gen):
+                # a reply from a wait we already abandoned (cancel lost the
+                # race) is dropped HERE, under the cv, so it can neither
+                # clear the current wait's tracking nor overwrite an
+                # unconsumed current-generation reply in the one-slot dict
                 if f.cancelled():
                     return
                 try:
-                    head_state["reply"] = f.result()
+                    kind, value = "reply", f.result()
                 except BaseException as e:  # noqa: BLE001
-                    head_state["error"] = e
+                    kind, value = "error", e
                 with self._direct_cv:
+                    if gen != head_state["gen"]:
+                        return  # stale generation
+                    head_state[kind] = (gen, value)
                     self._direct_cv.notify_all()
 
             def _issue_head_wait(ids, want):
@@ -670,7 +677,9 @@ class CoreWorker:
                         (rem_ + 10) if rem_ is not None else 3600,
                     )
                 )
-                fut.add_done_callback(_on_head)
+                head_state["gen"] += 1
+                gen = head_state["gen"]
+                fut.add_done_callback(lambda f, g=gen: _on_head(f, g))
                 return fut
 
             if pending_ids:
@@ -684,6 +693,7 @@ class CoreWorker:
                     # list order would let a slow early call starve
                     # detection of an already-finished later one)
                     still = []
+                    pending_grew = False
                     for i, oid in direct_ids:
                         if oid not in self._direct_pending:
                             if oid in self._memory_store or (
@@ -693,32 +703,47 @@ class CoreWorker:
                             else:
                                 # result was stored, not inlined: it sealed
                                 # at the head; fold into the head-path set
-                                # below (a fresh probe after the loop)
                                 pending_ids.append((i, oid))
+                                pending_grew = True
                         else:
                             still.append((i, oid))
                     direct_ids = still
+                    if pending_grew and "reply" not in head_state:
+                        # an in-flight head wait was issued BEFORE these
+                        # sealed-at-head oids joined pending_ids, so it could
+                        # block on unrelated refs even though the new oids
+                        # already satisfy num_returns.  Cancel it (a late
+                        # reply carries a stale generation and is ignored)
+                        # and re-issue below over the updated set — the
+                        # sealed oids make the fresh wait return immediately
+                        # when they cover the deficit.  A stale head error is
+                        # cleared too: the retry decides afresh.
+                        if head_fut is not None:
+                            head_fut.cancel()
+                            head_fut = None
+                        head_state.pop("error", None)
                     if "reply" in head_state:
-                        sealed = {
-                            bytes(o)
-                            for o in head_state.pop("reply").get("ready", [])
-                        }
-                        head_fut = None
-                        for i, oid in pending_ids:
-                            if oid in sealed:
-                                ready_idx.add(i)
-                        pending_ids = [
-                            (i, oid) for i, oid in pending_ids if i not in ready_idx
-                        ]
+                        gen, reply = head_state.pop("reply")
+                        if gen == head_state["gen"]:
+                            # current wait consumed; stale-generation replies
+                            # must not clear head_fut (the live wait stays)
+                            head_fut = None
+                            sealed = {bytes(o) for o in reply.get("ready", [])}
+                            for i, oid in pending_ids:
+                                if oid in sealed:
+                                    ready_idx.add(i)
+                            pending_ids = [
+                                (i, oid) for i, oid in pending_ids if i not in ready_idx
+                            ]
                     if len(ready_idx) >= num_returns:
                         break
                     if "error" in head_state and not direct_ids:
-                        # only fatal when still short AND no direct call can
-                        # still help: completions that satisfy num_returns
-                        # must win over a failed head rpc (the old
-                        # sequential path never contacted the head once
-                        # satisfied, and drained directs before the head)
-                        raise head_state["error"]
+                        gen, err = head_state.pop("error")
+                        if gen == head_state["gen"]:
+                            # only fatal when still short AND no direct call
+                            # can still help: completions that satisfy
+                            # num_returns must win over a failed head rpc
+                            raise err
                     rem = None if deadline is None else deadline - time.monotonic()
                     if rem is not None and rem <= 0:
                         break
@@ -770,9 +795,27 @@ class CoreWorker:
             (ready if i in ready_idx and len(ready) < num_returns else not_ready).append(ref)
         return ready, not_ready
 
+    def flush_ref_adds(self):
+        """Synchronously declare any batched local-ref adds at the head.
+
+        Call before an operation after which a PEER could legitimately drop
+        the last head-side pin on one of those refs — a direct-call reply
+        (the caller releases its arg keepalives on receipt), an explicit
+        free() (releases containment pins on nested refs we may have just
+        deserialized).  The 200ms batched flush must not lose that race:
+        a late ADD_REF would resurrect a count on an already-freed object."""
+        with self._refs_lock:
+            adds, self._pending_adds = self._pending_adds, []
+        if adds:
+            try:
+                self.request(MsgType.ADD_REF, {"object_ids": adds})
+            except Exception:
+                pass
+
     def free(self, refs: Sequence[ObjectRef]):
         for r in refs:
             self._memory_store.pop(r.binary(), None)
+        self.flush_ref_adds()
         self.request(MsgType.FREE_OBJECT, {"object_ids": [r.binary() for r in refs]})
 
     # ----------------------------------------------------------------- tasks
@@ -1313,13 +1356,7 @@ class CoreWorker:
         # in actor state) must be declared BEFORE the head unpins the args
         # on TASK_DONE, or the batched add could lose the race with a
         # driver-side delete
-        with self._refs_lock:
-            adds, self._pending_adds = self._pending_adds, []
-        if adds:
-            try:
-                self.request(MsgType.ADD_REF, {"object_ids": adds})
-            except Exception:
-                pass
+        self.flush_ref_adds()
         self.io.call(
             self.conn.send(
                 MsgType.TASK_DONE,

@@ -222,6 +222,12 @@ class WorkerRuntime:
                     )
             except Exception:
                 traceback.print_exc(file=sys.stderr)
+            # inline-only direct replies skip task_done, but refs this call
+            # deserialized into actor state still sit in the batched ADD_REF
+            # buffer — declare them before the caller (who holds the only
+            # head-visible pin via its arg keepalives) sees the reply and
+            # releases, or the late add resurrects a freed count
+            self.cw.flush_ref_adds()
             conn, rid = reply_to
             self.cw.io.spawn(
                 conn.reply(rid, {"inline": inline, "stored": sealed})
@@ -242,12 +248,12 @@ class WorkerRuntime:
             os._exit(1)  # lost the head: die, the head treats it as worker death
 
     def _apply_runtime_env(self, spec: TaskSpec):
-        """env_vars / working_dir / py_modules materialized in-process
-        before execution (reference: _private/runtime_env/ — theirs sets
-        up dedicated workers via the agent; pip/conda raise on this fixed
-        TPU-VM image, see _private/runtime_env.py).  Returns the sys.path
-        undo so a reused pool worker doesn't leak shipped modules into
-        later tasks."""
+        """env_vars / working_dir / py_modules / offline-pip-venv
+        materialized in-process before execution (reference:
+        _private/runtime_env/ — theirs sets up dedicated workers via the
+        agent; see _private/runtime_env.py).  Returns the undo so a
+        reused pool worker doesn't leak shipped modules or an activated
+        venv into later tasks."""
         from ray_tpu._private.runtime_env import apply_runtime_env
 
         return apply_runtime_env(

@@ -404,6 +404,7 @@ class HeadServer:
         from ray_tpu.gcs.storage import GcsWalStorage
 
         self._storage = GcsWalStorage(self.session_dir)
+        self._compact_lock = asyncio.Lock()
         self._restore_tables()
         # identity record: lets the NEXT incarnation remap directory/spill
         # entries that point at THIS head's (ephemeral) store segment
@@ -421,7 +422,8 @@ class HeadServer:
         self._shutdown = True
         if self._storage is not None:
             try:
-                self._storage.compact(self._snapshot_tables())
+                async with self._compact_lock:
+                    self._storage.compact(self._snapshot_tables())
             except Exception:
                 pass
         # kill all worker processes we know about
@@ -637,10 +639,21 @@ class HeadServer:
     async def _persist_loop(self):
         """Compaction pacing: the WAL already made every mutation durable;
         this loop just folds it into the base snapshot when it grows (or
-        periodically while dirty, bounding replay length)."""
+        periodically while dirty, bounding replay length).  Only phase 1
+        (serialize + WAL rotation) runs on the loop — snapshot file IO and
+        fsync happen in a thread so head RPCs never stall behind them; the
+        batched-fsync flusher also rides this loop's tick."""
         last_compact = time.time()
         while not self._shutdown:
             await asyncio.sleep(0.5)
+            try:
+                # bound the batched-fsync window; in a thread so head RPCs
+                # never wait on disk, under the lock so a concurrent
+                # begin_compact can't close the fd mid-fsync
+                async with self._compact_lock:
+                    await asyncio.to_thread(self._storage.sync)
+            except Exception:
+                pass
             grown = self._storage.wal_bytes > 4 * (1 << 20)
             periodic = self._tables_dirty and time.time() - last_compact > 10.0
             if not (grown or periodic):
@@ -648,10 +661,11 @@ class HeadServer:
             self._tables_dirty = False
             last_compact = time.time()
             try:
-                # ON the loop: snapshot + truncate must be atomic w.r.t.
-                # concurrent appends, or mutations between the snapshot
-                # and the truncate would vanish from both
-                self._storage.compact(self._snapshot_tables())
+                async with self._compact_lock:
+                    # phase 1 ON the loop: the snapshot must be consistent
+                    # with the WAL rotation point w.r.t. concurrent appends
+                    snapshot = self._storage.begin_compact(self._snapshot_tables())
+                    await asyncio.to_thread(self._storage.finish_compact, snapshot)
             except Exception:
                 logger.exception("GCS compaction failed")
 
@@ -2236,18 +2250,21 @@ class HeadServer:
             return
         remaining: List[TaskEntry] = []
         spawn_demand: Dict[bytes, int] = {}
-        # dispatch-capacity snapshot: idle workers + spawnable slots.  Once
-        # it hits zero NOTHING can dispatch this tick, so stop scanning —
-        # without this a deep backlog (10k+ queued) pays an O(queue) scan
-        # per tick, O(queue²) per drain (measured 140s for a 10k drain).
-        # Counting is conservative (idle TPU workers count as slots for
-        # CPU tasks), which only lengthens the scan, never skips a
-        # dispatchable task.
-        free_slots = 0
+        # dispatch-capacity snapshot, PER NODE: idle workers + spawnable
+        # slots.  Once the cluster-wide total hits zero NOTHING can dispatch
+        # this tick, so stop scanning — without this a deep backlog (10k+
+        # queued) pays an O(queue) scan per tick, O(queue²) per drain
+        # (measured 140s for a 10k drain).  Per-node counters (not one
+        # global counter) so a backlog head pinned to one saturated node
+        # cannot exhaust the budget and hide tasks placeable on OTHER idle
+        # nodes in the same tick.  Counting is conservative (idle TPU
+        # workers count as slots for CPU tasks), which only lengthens the
+        # scan, never skips a dispatchable task.
+        node_slots: Dict[bytes, int] = {}
         for node in self.nodes.values():
             if not node.alive:
                 continue
-            free_slots += sum(
+            idle = sum(
                 1
                 for w in node.workers.values()
                 if w.idle and w.actor_id is None and not w.dedicated
@@ -2256,13 +2273,22 @@ class HeadServer:
                 2, int(node.resources_total.get("CPU", 2))
             )
             headroom = RayConfig.worker_pool_max_workers - len(node.workers)
-            free_slots += max(0, min(headroom, limit) - node.starting_workers)
+            node_slots[node.node_id] = idle + max(
+                0, min(headroom, limit) - node.starting_workers
+            )
+        total_slots = sum(node_slots.values())
         # tasks that reserved resources but found no idle worker this tick;
         # reservations are held until the end so demand is capped by what the
         # node can actually run simultaneously (not by queue length)
         unfulfilled: List[Tuple[TaskEntry, NodeInfo]] = []
+        # bound the pick+release work spent skipping past a backlog pinned
+        # to slot-exhausted nodes: past this many skips the rest of the
+        # queue waits for the next tick (keeps a 10k-deep single-node
+        # backlog from restoring the O(queue²) drain while another node
+        # holds one idle slot)
+        exhausted_skips = 64 + 8 * len(node_slots)
         for i, entry in enumerate(self.task_queue):
-            if free_slots <= 0:
+            if total_slots <= 0 or exhausted_skips <= 0:
                 remaining.extend(self.task_queue[i:])
                 break
             spec = entry.spec
@@ -2273,16 +2299,26 @@ class HeadServer:
                 # infeasible tasks queued and warns; the autoscaler reacts).
                 remaining.append(entry)
                 continue
+            if node_slots.get(node.node_id, 0) <= 0:
+                # this node's dispatch capacity is spent for the tick, but
+                # other nodes may still have slots: release the reservation
+                # and keep scanning rather than burning the global budget
+                self._release_task_resources(node, spec)
+                remaining.append(entry)
+                exhausted_skips -= 1
+                continue
             worker = self._find_idle_worker(node, spec)
             if worker is None:
                 key = (node.node_id, self._needs_tpu(spec))
                 spawn_demand[key] = spawn_demand.get(key, 0) + 1
                 unfulfilled.append((entry, node))
                 remaining.append(entry)
-                free_slots -= 1  # consumed a spawn slot
+                node_slots[node.node_id] -= 1  # consumed a spawn slot
+                total_slots -= 1
                 continue
             await self._dispatch(entry, node, worker)
-            free_slots -= 1
+            node_slots[node.node_id] -= 1
+            total_slots -= 1
         for entry, node in unfulfilled:
             self._release_task_resources(node, entry.spec)
         self.task_queue = remaining

@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import pickle
 import struct
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -58,14 +59,29 @@ class GcsWalStorage:
 
     Record framing: u32 length | u32 crc32 | pickle payload — a torn tail
     record (crash mid-append) is detected by the crc/length check and
-    replay stops there, keeping every record before it."""
+    replay stops there, keeping every record before it.
+
+    Durability boundary: every append is flushed to the OS immediately
+    (survives process crash).  fsync is batched OFF the append path: the
+    owner's periodic ``sync()`` (the GCS _persist_loop, 0.5s tick, run in
+    a thread so head RPCs never stall behind disk latency) makes the tail
+    durable — an OS/power loss can drop at most the final ~0.5s of
+    mutations.  The reference's Redis mode has the same shape (redis
+    appendfsync everysec, redis.conf default).
+    """
 
     _HDR = struct.Struct("<II")
 
     def __init__(self, dir_path: str):
         self.base = GcsSnapshotStorage(os.path.join(dir_path, "gcs_base.pkl"))
         self.wal_path = os.path.join(dir_path, "gcs_wal.log")
+        # a crash between begin_compact() (WAL rotated) and finish_compact()
+        # (snapshot durable) leaves the rotated segment here; load() replays
+        # it between the base and the live WAL
+        self.rotated_path = self.wal_path + ".compacting"
         self._f = None
+        self._last_fsync = 0.0
+        self._fsync_pending = False
         self.wal_bytes = 0
         self.wal_records = 0
 
@@ -81,45 +97,107 @@ class GcsWalStorage:
         f.write(self._HDR.pack(len(payload), zlib.crc32(payload)))
         f.write(payload)
         f.flush()
+        self._fsync_pending = True
         self.wal_bytes += self._HDR.size + len(payload)
         self.wal_records += 1
+
+    def sync(self):
+        """Force any batched-but-unsynced appends to disk.  May run in a
+        thread: the flag clears BEFORE the fsync so an append landing
+        mid-fsync re-arms it (clearing after would mark that append
+        durable without ever syncing it)."""
+        if self._f is not None and self._fsync_pending:
+            self._fsync_pending = False
+            os.fsync(self._f.fileno())
+            self._last_fsync = time.monotonic()
+
+    @classmethod
+    def _replay_file(cls, path: str, records: List[Tuple]):
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(cls._HDR.size)
+                if len(hdr) < cls._HDR.size:
+                    break
+                length, crc = cls._HDR.unpack(hdr)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break  # torn tail: stop at the last whole record
+                try:
+                    records.append(pickle.loads(payload))
+                except Exception:
+                    break
 
     def load(self) -> Tuple[Optional[Dict[str, Any]], List[Tuple]]:
         tables = self.base.load()
         records: List[Tuple] = []
-        if os.path.exists(self.wal_path):
-            with open(self.wal_path, "rb") as f:
-                while True:
-                    hdr = f.read(self._HDR.size)
-                    if len(hdr) < self._HDR.size:
-                        break
-                    length, crc = self._HDR.unpack(hdr)
-                    payload = f.read(length)
-                    if len(payload) < length or zlib.crc32(payload) != crc:
-                        break  # torn tail: stop at the last whole record
-                    try:
-                        records.append(pickle.loads(payload))
-                    except Exception:
-                        break
+        self._replay_file(self.rotated_path, records)
+        self._replay_file(self.wal_path, records)
         return tables, records
 
-    def compact(self, tables: Dict[str, Any]):
-        """Fold the WAL into a fresh base snapshot and truncate it."""
-        self.base.save(tables)
+    def begin_compact(self, tables: Dict[str, Any]) -> bytes:
+        """Phase 1 (call ON the mutation thread/loop): serialize the
+        snapshot and rotate the WAL so new appends land in a fresh segment.
+        Cheap relative to phase 2 — no data-file IO beyond the rotation.
+
+        A leftover rotated segment (crash between the phases) is MERGED,
+        not clobbered: its records are only durable there until some
+        finish_compact lands a snapshot containing them, and the caller's
+        `tables` does contain them (load() replayed the segment) — but if
+        THIS compaction also crashes before phase 2, the disk must still
+        hold every record."""
+        snapshot = pickle.dumps(tables, protocol=5)
         if self._f is not None:
+            if self._fsync_pending:
+                os.fsync(self._f.fileno())
+                self._fsync_pending = False
             self._f.close()
             self._f = None
-        with open(self.wal_path, "wb"):
-            pass
+        if os.path.exists(self.wal_path):
+            if os.path.exists(self.rotated_path):
+                with open(self.rotated_path, "ab") as dst, open(self.wal_path, "rb") as src:
+                    while True:
+                        chunk = src.read(1 << 20)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+                    dst.flush()
+                    os.fsync(dst.fileno())
+                os.unlink(self.wal_path)
+            else:
+                os.replace(self.wal_path, self.rotated_path)
         self.wal_bytes = 0
         self.wal_records = 0
+        return snapshot
+
+    def finish_compact(self, snapshot: bytes):
+        """Phase 2 (safe OFF the loop — touches only the base file and the
+        rotated segment, which the appender never writes): make the
+        snapshot durable, then drop the folded-in WAL segment."""
+        tmp = self.base.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(snapshot)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.base.path)
+        try:
+            os.unlink(self.rotated_path)
+        except OSError:
+            pass
+
+    def compact(self, tables: Dict[str, Any]):
+        """Fold the WAL into a fresh base snapshot and truncate it
+        (synchronous composition of the two phases, for shutdown/restore)."""
+        self.finish_compact(self.begin_compact(tables))
 
     def delete(self):
         self.base.delete()
         if self._f is not None:
             self._f.close()
             self._f = None
-        try:
-            os.unlink(self.wal_path)
-        except OSError:
-            pass
+        for p in (self.wal_path, self.rotated_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
