@@ -3,11 +3,13 @@ from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy, DQNWorker  # noqa: F401
 from ray_tpu.rllib.env import (  # noqa: F401
+    PendulumEnv,
     SyncVectorEnv,
     SyntheticPixelEnv,
     VectorEnv,
     make_vector_env,
 )
+from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy, SACWorker  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.multi_agent import (  # noqa: F401
     MultiAgentEnv,
@@ -16,7 +18,12 @@ from ray_tpu.rllib.multi_agent import (  # noqa: F401
 )
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServer  # noqa: F401
-from ray_tpu.rllib.models import CNNModel, MLPModel, get_model  # noqa: F401
+from ray_tpu.rllib.models import (  # noqa: F401
+    CNNModel,
+    GaussianMLPModel,
+    MLPModel,
+    get_model,
+)
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
 from ray_tpu.rllib.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,

@@ -1,0 +1,83 @@
+"""Action distributions for continuous control.
+
+Analog of the reference's torch action distributions (reference:
+rllib/models/torch/torch_action_dist.py:236 TorchDiagGaussian, :316
+TorchSquashedGaussian).  Pure jnp functions over (mean, log_std) tensors
+— no distribution objects cross a jit boundary, so policies compose them
+freely inside jitted samplers and losses.
+
+The squashed form is the SAC actor: a = tanh(u), u ~ N(mean, std), with
+the exact change-of-variables correction
+    log p(a) = log N(u) - sum_i log(1 - tanh(u_i)^2)
+computed in the numerically-stable softplus form
+    log(1 - tanh(u)^2) = 2 * (log 2 - u - softplus(-2u)).
+"""
+
+from __future__ import annotations
+
+import math
+
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def diag_gaussian_sample(key, mean, log_std):
+    import jax
+    import jax.numpy as jnp
+
+    std = jnp.exp(log_std)
+    return mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+
+
+def diag_gaussian_logp(x, mean, log_std):
+    """log N(x; mean, exp(log_std)) summed over the action dim."""
+    import jax.numpy as jnp
+
+    z = (x - mean) * jnp.exp(-log_std)
+    return jnp.sum(-0.5 * z**2 - log_std - _HALF_LOG_2PI, axis=-1)
+
+
+def diag_gaussian_entropy(log_std):
+    import jax.numpy as jnp
+
+    return jnp.sum(log_std + 0.5 + _HALF_LOG_2PI, axis=-1)
+
+
+def _log1m_tanh2(u):
+    """log(1 - tanh(u)^2), stable for large |u| (softplus form)."""
+    import jax
+    import jax.numpy as jnp
+
+    return 2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+
+
+def squashed_sample_logp(key, mean, log_std):
+    """Reparameterized tanh-Gaussian sample in (-1, 1) and its log-prob.
+
+    Returns (a, logp): a = tanh(u) with u = mean + std*eps (gradients flow
+    through a), logp = log N(u) - sum log(1 - tanh(u)^2)."""
+    import jax
+    import jax.numpy as jnp
+
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    u = diag_gaussian_sample(key, mean, log_std)
+    a = jnp.tanh(u)
+    logp = diag_gaussian_logp(u, mean, log_std) - jnp.sum(_log1m_tanh2(u), axis=-1)
+    return a, logp
+
+
+def squashed_logp(a, mean, log_std, eps: float = 1e-6):
+    """log-prob of a GIVEN squashed action in (-1, 1)."""
+    import jax.numpy as jnp
+
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    u = jnp.arctanh(jnp.clip(a, -1.0 + eps, 1.0 - eps))
+    return diag_gaussian_logp(u, mean, log_std) - jnp.sum(_log1m_tanh2(u), axis=-1)
+
+
+def squashed_mode(mean):
+    """Deterministic (evaluation) action."""
+    import jax.numpy as jnp
+
+    return jnp.tanh(mean)

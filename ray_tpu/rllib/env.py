@@ -21,11 +21,15 @@ import numpy as np
 
 
 class Box:
-    """Minimal observation-space stand-in (shape + dtype)."""
+    """Minimal box-space stand-in (shape + dtype [+ bounds]).  With
+    low/high set it also serves as a continuous ACTION space (reference
+    analog: gym.spaces.Box used by SAC/DDPG action heads)."""
 
-    def __init__(self, shape: Tuple[int, ...], dtype=np.float32):
+    def __init__(self, shape: Tuple[int, ...], dtype=np.float32, low=None, high=None):
         self.shape = tuple(shape)
         self.dtype = dtype
+        self.low = None if low is None else np.broadcast_to(low, self.shape).astype(np.float32)
+        self.high = None if high is None else np.broadcast_to(high, self.shape).astype(np.float32)
 
 
 class Discrete:
@@ -99,6 +103,84 @@ def make_vector_env(env_creator: Callable, num_envs: int, seed: int = 0) -> Vect
     v = SyncVectorEnv(envs)
     v.reset(seed=seed)
     return v
+
+
+class PendulumEnv(VectorEnv):
+    """Natively vectorized classic pendulum swing-up (the Pendulum-v1
+    dynamics, gymnasium/envs/classic_control/pendulum.py, re-realized as
+    one numpy batch — no per-env Python loop): obs [cos θ, sin θ, θ̇],
+    torque action in [-max_torque, max_torque], reward
+    -(θ² + 0.1 θ̇² + 0.001 u²), 200-step episodes with auto-reset.
+    The continuous-control benchmark env for SAC (reference analog:
+    Pendulum-v1 in rllib/algorithms/sac tuned examples)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    HORIZON = 200
+
+    def __init__(self, num_envs: int = 16, seed: int = 0):
+        self.num_envs = int(num_envs)
+        self.observation_space = Box((3,), np.float32)
+        self.action_space = Box(
+            (1,), np.float32, low=-self.MAX_TORQUE, high=self.MAX_TORQUE
+        )
+        self._rng = np.random.default_rng(seed)
+        self._th = np.zeros(self.num_envs, np.float64)
+        self._thdot = np.zeros(self.num_envs, np.float64)
+        self._t = np.zeros(self.num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack(
+            [np.cos(self._th), np.sin(self._th), self._thdot], axis=-1
+        ).astype(np.float32)
+
+    def _spawn(self, idx: np.ndarray):
+        k = len(idx)
+        if not k:
+            return
+        self._th[idx] = self._rng.uniform(-np.pi, np.pi, k)
+        self._thdot[idx] = self._rng.uniform(-1.0, 1.0, k)
+        self._t[idx] = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._spawn(np.arange(self.num_envs))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(
+            np.asarray(actions, np.float64).reshape(self.num_envs, -1)[:, 0],
+            -self.MAX_TORQUE,
+            self.MAX_TORQUE,
+        )
+        th_norm = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        rewards = -(th_norm**2 + 0.1 * self._thdot**2 + 0.001 * u**2)
+        # g=10, m=1, l=1 dynamics
+        self._thdot = np.clip(
+            self._thdot
+            + (1.5 * self.G * np.sin(self._th) + 3.0 * u) * self.DT,
+            -self.MAX_SPEED,
+            self.MAX_SPEED,
+        )
+        self._th = self._th + self._thdot * self.DT
+        self._t += 1
+        dones = self._t >= self.HORIZON
+        # pendulum episodes only ever end by TIME LIMIT — flag it plus the
+        # pre-reset observation (gym conventions) so off-policy learners
+        # can bootstrap through the cut from the TRUE final state instead
+        # of treating it as terminal or bootstrapping off the reset obs
+        final = self._obs() if dones.any() else None
+        self._spawn(np.nonzero(dones)[0])
+        infos = [
+            {"TimeLimit.truncated": True, "final_observation": final[i]}
+            if d
+            else {}
+            for i, d in enumerate(dones)
+        ]
+        return self._obs(), rewards.astype(np.float32), dones, infos
 
 
 class SyntheticPixelEnv(VectorEnv):

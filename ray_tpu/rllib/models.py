@@ -27,6 +27,18 @@ def _dense_init(rng, fan_in: int, fan_out: int, scale: float = 2.0):
     return {"w": w, "b": jnp.zeros(fan_out)}
 
 
+def mlp_init(rng, sizes: Sequence[int]):
+    """A dense stack as a layer list (shared by the catalog models and the
+    SAC critics)."""
+    import jax
+
+    keys = jax.random.split(rng, len(sizes) - 1)
+    return [
+        _dense_init(k, fi, fo)
+        for k, (fi, fo) in zip(keys, zip(sizes[:-1], sizes[1:]))
+    ]
+
+
 class MLPModel:
     """Separate pi / vf towers (matches the original JaxPolicy layout so
     seeded initialization is reproducible across rounds)."""
@@ -150,6 +162,50 @@ class CNNModel:
         return logits, value
 
 
+class GaussianMLPModel:
+    """Continuous-action actor: MLP trunk → (mean, log_std) heads, plus a
+    separate value tower (reference analog: the catalog wiring a
+    DiagGaussian/SquashedGaussian head for Box action spaces,
+    rllib/models/catalog.py + torch_action_dist.py:236).  apply returns
+    ((mean, log_std), value); the caller picks the distribution
+    (ray_tpu/rllib/distributions.py) — plain DiagGaussian for PPO-style
+    losses, tanh-squashed for SAC."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], act_dim: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dim = int(np.prod(obs_shape))
+        self.act_dim = int(act_dim)
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        import jax
+
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        return {
+            "trunk": mlp_init(k1, (self.obs_dim, *self.hidden)),
+            "mean": _dense_init(k2, self.hidden[-1], self.act_dim, scale=0.02),
+            "log_std": _dense_init(k3, self.hidden[-1], self.act_dim, scale=0.02),
+            "vf": mlp_init(k4, (self.obs_dim, *self.hidden, 1)),
+        }
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        h = x
+        for layer in params["trunk"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        mean = h @ params["mean"]["w"] + params["mean"]["b"]
+        log_std = h @ params["log_std"]["w"] + params["log_std"]["b"]
+        v = x
+        for i, layer in enumerate(params["vf"]):
+            v = v @ layer["w"] + layer["b"]
+            if i < len(params["vf"]) - 1:
+                v = jnp.tanh(v)
+        return (mean, log_std), v[..., 0]
+
+
 def get_model(
     obs_shape: Tuple[int, ...],
     num_actions: int,
@@ -168,4 +224,7 @@ def get_model(
     if kind == "mlp":
         hidden = cfg.pop("hidden", (64, 64))
         return MLPModel(obs_shape, num_actions, hidden=hidden)
+    if kind == "gaussian_mlp":
+        hidden = cfg.pop("hidden", (64, 64))
+        return GaussianMLPModel(obs_shape, num_actions, hidden=hidden)
     raise ValueError(f"unknown model type {kind!r}")

@@ -1,0 +1,425 @@
+"""SAC: off-policy continuous control with twin Q and learned temperature.
+
+Analog of the reference's SAC (reference: rllib/algorithms/sac/sac.py —
+replay-driven training_step; rllib/algorithms/sac/sac_torch_policy.py:
+actor_critic_loss with twin Q, tanh-squashed Gaussian actor and
+entropy-temperature auto-tuning).  TPU-first realization: actor, twin
+critics and the temperature update all happen in ONE jitted program per
+minibatch (the reference runs three separate torch optimizer passes);
+the tanh-Gaussian sampling rides the shared distribution helpers
+(ray_tpu/rllib/distributions.py) and target networks update with a
+fused polyak inside the same program.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import GaussianMLPModel, mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+def _mlp_apply(layers, x):
+    import jax.numpy as jnp
+
+    h = x
+    for i, layer in enumerate(layers):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            h = jnp.maximum(h, 0.0)
+    return h
+
+
+class SACPolicy:
+    """Squashed-Gaussian actor + twin Q critics + learned alpha, all
+    updated in one jitted step."""
+
+    def __init__(
+        self,
+        obs_shape,
+        act_dim: int,
+        action_low: Optional[np.ndarray] = None,
+        action_high: Optional[np.ndarray] = None,
+        actor_lr: float = 3e-4,
+        critic_lr: float = 3e-4,
+        alpha_lr: float = 3e-4,
+        gamma: float = 0.99,
+        tau: float = 0.005,
+        hidden=(256, 256),
+        target_entropy: Optional[float] = None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.distributions import (
+            squashed_mode,
+            squashed_sample_logp,
+        )
+
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dim = int(np.prod(obs_shape))
+        self.act_dim = int(act_dim)
+        self.gamma = gamma
+        self.tau = tau
+        self.target_entropy = (
+            float(target_entropy) if target_entropy is not None else -float(act_dim)
+        )
+        # env-unit affine: env_action = center + scale * a,  a in (-1, 1)
+        low = np.full(act_dim, -1.0) if action_low is None else np.asarray(action_low)
+        high = np.full(act_dim, 1.0) if action_high is None else np.asarray(action_high)
+        self._scale = ((high - low) / 2.0).astype(np.float32)
+        self._center = ((high + low) / 2.0).astype(np.float32)
+
+        self.actor = GaussianMLPModel(self.obs_shape, act_dim, hidden=tuple(hidden))
+        rng = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(rng, 3)
+        self.actor_params = self.actor.init(ka)
+        q_sizes = (self.obs_dim + act_dim, *hidden, 1)
+        self.q_params = {"q1": mlp_init(k1, q_sizes), "q2": mlp_init(k2, q_sizes)}
+        self.q_target = jax.tree.map(lambda x: x, self.q_params)
+        self.log_alpha = jnp.zeros(())
+
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        self.alpha_opt = optax.adam(alpha_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.q_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        actor = self.actor
+        gamma_, tau_, tgt_ent = self.gamma, self.tau, self.target_entropy
+
+        def q_all(qp, obs, act):
+            x = jnp.concatenate([obs, act], axis=-1)
+            return _mlp_apply(qp["q1"], x)[..., 0], _mlp_apply(qp["q2"], x)[..., 0]
+
+        @jax.jit
+        def _act(params, obs, key):
+            (mean, log_std), _ = actor.apply(params, obs)
+            a, _ = squashed_sample_logp(key, mean, log_std)
+            return a
+
+        @jax.jit
+        def _act_det(params, obs):
+            (mean, _), _ = actor.apply(params, obs)
+            return squashed_mode(mean)
+
+        @jax.jit
+        def _update(
+            actor_params, q_params, q_target, log_alpha,
+            actor_os, critic_os, alpha_os,
+            key, obs, act, rew, next_obs, done,
+        ):
+            k_next, k_pi = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critics: TD target from the target twins + entropy bonus
+            def critic_loss(qp):
+                (mean, log_std), _ = actor.apply(actor_params, next_obs)
+                a2, logp2 = squashed_sample_logp(k_next, mean, log_std)
+                t1, t2 = q_all(q_target, next_obs, a2)
+                backup = rew + gamma_ * (1.0 - done) * (
+                    jnp.minimum(t1, t2) - alpha * logp2
+                )
+                backup = jax.lax.stop_gradient(backup)
+                q1, q2 = q_all(qp, obs, act)
+                return ((q1 - backup) ** 2 + (q2 - backup) ** 2).mean(), (q1.mean(), q2.mean())
+
+            (closs, (q1m, q2m)), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(q_params)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os)
+            import optax as _optax
+
+            q_params = _optax.apply_updates(q_params, cupd)
+
+            # --- actor: maximize min-Q of reparameterized action - alpha*logp
+            def actor_loss(ap):
+                (mean, log_std), _ = actor.apply(ap, obs)
+                a_pi, logp = squashed_sample_logp(k_pi, mean, log_std)
+                q1, q2 = q_all(q_params, obs, a_pi)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            (aloss, logp), agrads = jax.value_and_grad(actor_loss, has_aux=True)(actor_params)
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os)
+            actor_params = _optax.apply_updates(actor_params, aupd)
+
+            # --- temperature: match the entropy target
+            def alpha_loss(la):
+                return -(la * jax.lax.stop_gradient(logp + tgt_ent)).mean()
+
+            lloss, lgrads = jax.value_and_grad(alpha_loss)(log_alpha)
+            lupd, alpha_os = self.alpha_opt.update(lgrads, alpha_os)
+            log_alpha = _optax.apply_updates(log_alpha, lupd)
+
+            # --- fused polyak target update
+            q_target_new = jax.tree.map(
+                lambda t, o: (1.0 - tau_) * t + tau_ * o, q_target, q_params
+            )
+            metrics = {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+                "alpha_loss": lloss,
+                "alpha": alpha,
+                "entropy": -logp.mean(),
+                "q1_mean": q1m,
+                "q2_mean": q2m,
+            }
+            return (
+                actor_params, q_params, q_target_new, log_alpha,
+                actor_os, critic_os, alpha_os, metrics,
+            )
+
+        self._act = _act
+        self._act_det = _act_det
+        self._update = _update
+
+    # --------------------------------------------------------------- acting
+
+    def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
+        """Returns (env_actions, raw_actions): raw in (-1,1) is what the
+        learner stores; env units go to the env."""
+        import jax
+
+        obs = np.asarray(obs, np.float32)
+        if deterministic:
+            raw = np.asarray(self._act_det(self.actor_params, obs))
+        else:
+            self._rng, key = jax.random.split(self._rng)
+            raw = np.asarray(self._act(self.actor_params, obs, key))
+        return self._center + self._scale * raw, raw
+
+    # -------------------------------------------------------------- learning
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        (
+            self.actor_params, self.q_params, self.q_target, self.log_alpha,
+            self.actor_opt_state, self.critic_opt_state, self.alpha_opt_state,
+            metrics,
+        ) = self._update(
+            self.actor_params, self.q_params, self.q_target, self.log_alpha,
+            self.actor_opt_state, self.critic_opt_state, self.alpha_opt_state,
+            key,
+            np.asarray(batch[OBS], np.float32),
+            np.asarray(batch[ACTIONS], np.float32),
+            np.asarray(batch[REWARDS], np.float32),
+            np.asarray(batch[NEXT_OBS], np.float32),
+            np.asarray(batch[DONES], np.float32),
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.actor_params)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.actor_params = jax.tree.map(jnp.asarray, weights)
+
+
+class SACWorker:
+    """Rollout actor: stochastic-policy stepping over a VectorEnv,
+    storing RAW (-1,1) actions so the learner's log-probs line up."""
+
+    def __init__(self, env_creator, policy_config, seed=0, num_envs: int = 1):
+        from ray_tpu.rllib.env import make_vector_env
+
+        self.env = make_vector_env(env_creator, num_envs, seed=seed)
+        self.num_envs = self.env.num_envs
+        space = self.env.action_space
+        self.policy = SACPolicy(
+            obs_shape=tuple(self.env.observation_space.shape),
+            act_dim=int(np.prod(space.shape)),
+            action_low=space.low,
+            action_high=space.high,
+            seed=seed,
+            **policy_config,
+        )
+        self._obs = self.env.reset(seed=seed)
+        self.episode_rewards = []
+        self._ep_reward = np.zeros(self.num_envs, np.float64)
+        self._rng = np.random.default_rng(seed + 10_000)
+
+    def sample(self, num_steps: int, random_actions: bool = False) -> SampleBatch:
+        rows = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, DONES)}
+        rng = self._rng  # persistent: warmup calls must not replay draws
+        for _ in range(num_steps):
+            obs = self._obs
+            if random_actions:
+                raw = rng.uniform(-1, 1, (self.num_envs, self.policy.act_dim)).astype(
+                    np.float32
+                )
+                env_actions = self.policy._center + self.policy._scale * raw
+            else:
+                env_actions, raw = self.policy.compute_actions(obs)
+            next_obs, rewards, dones, infos = self.env.step(env_actions)
+            # bootstrap through time-limit cuts: a truncated episode's
+            # state is NOT terminal, so the TD target must keep its value —
+            # and must bootstrap from the TRUE final obs, not the
+            # auto-reset obs (gym "TimeLimit.truncated"/"final_observation"
+            # conventions; reference SAC treats truncation as non-terminal)
+            store_next = next_obs
+            terminated = np.asarray(dones, bool).copy()
+            for i, d in enumerate(dones):
+                if not d:
+                    continue
+                info = infos[i] or {}
+                if info.get("TimeLimit.truncated", False):
+                    terminated[i] = False
+                fo = info.get("final_observation")
+                if fo is not None:
+                    if store_next is next_obs:
+                        store_next = next_obs.copy()
+                    store_next[i] = fo
+            rows[OBS].append(obs)
+            rows[ACTIONS].append(raw)
+            rows[REWARDS].append(rewards)
+            rows[NEXT_OBS].append(store_next)
+            rows[DONES].append(terminated)
+            self._ep_reward += rewards
+            for i in np.nonzero(dones)[0]:
+                self.episode_rewards.append(float(self._ep_reward[i]))
+                self._ep_reward[i] = 0.0
+            self._obs = next_obs
+        return SampleBatch(
+            {
+                k: np.stack(v).reshape(-1, *np.asarray(v[0]).shape[1:])
+                for k, v in rows.items()
+            }
+        )
+
+    def set_weights(self, weights):
+        self.policy.set_weights(weights)
+        return True
+
+    def episode_stats(self, last_n: int = 20):
+        recent = self.episode_rewards[-last_n:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }
+
+
+@dataclass
+class SACConfig(AlgorithmConfig):
+    buffer_size: int = 100_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 256
+    num_train_per_iter: int = 64  # gradient steps per train()
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    tau: float = 0.005
+    hidden: tuple = (256, 256)
+    target_entropy: Optional[float] = None
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    """Replay-driven training loop (reference: sac.py training_step):
+    rollout workers push transitions; the driver-side jitted learner
+    takes num_train_per_iter gradient steps per iteration."""
+
+    def __init__(self, config: SACConfig):
+        super().__init__(config)
+        env = config.env_creator()
+        obs_shape = tuple(env.observation_space.shape)
+        space = env.action_space
+        act_dim = int(np.prod(space.shape))
+        low, high = space.low, space.high
+        del env
+        policy_config = {
+            "actor_lr": config.actor_lr,
+            "critic_lr": config.critic_lr,
+            "alpha_lr": config.alpha_lr,
+            "gamma": config.gamma,
+            "tau": config.tau,
+            "hidden": tuple(config.hidden),
+            "target_entropy": config.target_entropy,
+        }
+        self.policy = SACPolicy(
+            obs_shape=obs_shape,
+            act_dim=act_dim,
+            action_low=low,
+            action_high=high,
+            seed=config.seed,
+            **policy_config,
+        )
+        worker_cls = ray_tpu.remote(SACWorker)
+        self.workers = [
+            worker_cls.remote(
+                config.env_creator,
+                policy_config,
+                seed=config.seed + i,
+                num_envs=config.num_envs_per_worker,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self.total_steps = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.time()
+        weights_ref = ray_tpu.put(self.policy.get_weights())
+        ray_tpu.get([w.set_weights.remote(weights_ref) for w in self.workers], timeout=300)
+        per_env = max(1, -(-cfg.rollout_fragment_length // cfg.num_envs_per_worker))
+        warmup = len(self.buffer) < cfg.learning_starts
+        batches = ray_tpu.get(
+            [w.sample.remote(per_env, warmup) for w in self.workers], timeout=600
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self.total_steps += len(b)
+
+        metrics: Dict[str, float] = {}
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_train_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                metrics = self.policy.learn_on_batch(mb)
+                updates += 1
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers], timeout=120)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.total_steps,
+            "num_grad_updates": updates,
+            "episode_reward_mean": float(
+                np.mean([s["episode_reward_mean"] for s in stats if s["episodes"] > 0] or [0.0])
+            ),
+            "episodes_total": int(sum(s["episodes"] for s in stats)),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

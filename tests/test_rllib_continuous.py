@@ -1,0 +1,184 @@
+"""Continuous-action RL: squashed-Gaussian distribution math, the
+vectorized Pendulum env, and SAC learning it (VERDICT r4 missing #2;
+reference analogs: rllib/models/torch/torch_action_dist.py:236,
+rllib/algorithms/sac/sac.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ distributions
+
+
+def test_squashed_gaussian_logp_matches_numerical():
+    """Analytic tanh-corrected log-prob == numerical change-of-variables
+    (finite-difference of the CDF is overkill; instead check against the
+    explicit formula with arctanh round-trip at moderate u)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.distributions import (
+        diag_gaussian_logp,
+        squashed_logp,
+        squashed_sample_logp,
+    )
+
+    key = jax.random.PRNGKey(0)
+    mean = jnp.array([[0.3, -0.7], [0.0, 1.2]])
+    log_std = jnp.array([[-0.5, 0.1], [-1.0, 0.0]])
+    a, logp = squashed_sample_logp(key, mean, log_std)
+    assert a.shape == (2, 2)
+    assert np.all(np.abs(np.asarray(a)) < 1.0)
+    # recompute from the action: must agree with the sampled-path logp
+    logp2 = squashed_logp(a, mean, log_std)
+    np.testing.assert_allclose(np.asarray(logp), np.asarray(logp2), rtol=1e-4)
+    # and it must equal base gaussian logp minus the jacobian term
+    u = np.arctanh(np.clip(np.asarray(a), -1 + 1e-6, 1 - 1e-6))
+    base = np.asarray(diag_gaussian_logp(jnp.asarray(u), mean, log_std))
+    jac = np.sum(np.log(1 - np.tanh(u) ** 2 + 1e-12), axis=-1)
+    np.testing.assert_allclose(np.asarray(logp), base - jac, rtol=1e-3)
+
+
+def test_squashed_sample_integrates_to_one_1d():
+    """In 1-D, exp(logp) over a grid of actions must integrate to ~1 —
+    the tanh jacobian correction is exactly what makes this hold."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.distributions import squashed_logp
+
+    grid = np.linspace(-0.999, 0.999, 4001)[:, None]
+    mean = jnp.full((4001, 1), 0.4)
+    log_std = jnp.full((4001, 1), -0.3)
+    logp = np.asarray(squashed_logp(jnp.asarray(grid, jnp.float32), mean, log_std))
+    integral = np.trapezoid(np.exp(logp), grid[:, 0])
+    assert abs(integral - 1.0) < 2e-2, integral
+
+
+def test_gaussian_mlp_model_shapes():
+    import jax
+
+    from ray_tpu.rllib.models import get_model
+
+    model = get_model((3,), 2, {"type": "gaussian_mlp", "hidden": (16, 16)})
+    params = model.init(jax.random.PRNGKey(0))
+    (mean, log_std), value = model.apply(params, np.zeros((5, 3), np.float32))
+    assert mean.shape == (5, 2) and log_std.shape == (5, 2)
+    assert value.shape == (5,)
+
+
+# ------------------------------------------------------------------- env
+
+
+def test_pendulum_env_contract():
+    from ray_tpu.rllib.env import PendulumEnv
+
+    env = PendulumEnv(num_envs=4, seed=1)
+    obs = env.reset(seed=1)
+    assert obs.shape == (4, 3)
+    assert env.action_space.low.shape == (1,) and env.action_space.high[0] == 2.0
+    total_done = 0
+    for _ in range(200):
+        obs, rew, done, _ = env.step(np.zeros((4, 1), np.float32))
+        assert obs.shape == (4, 3) and rew.shape == (4,)
+        assert (rew <= 0).all()  # pendulum reward is always non-positive
+        total_done += int(done.sum())
+    assert total_done == 4  # horizon auto-reset fired exactly once per env
+
+
+# ------------------------------------------------------------------- SAC
+
+
+def test_sac_learns_pendulum():
+    """Driver-side jitted learner + vectorized env: episode reward must
+    improve substantially from the random-policy baseline (~-1300)."""
+    from ray_tpu.rllib.env import PendulumEnv
+    from ray_tpu.rllib.replay_buffer import ReplayBuffer
+    from ray_tpu.rllib.sac import SACPolicy
+    from ray_tpu.rllib.sample_batch import (
+        ACTIONS,
+        DONES,
+        NEXT_OBS,
+        OBS,
+        REWARDS,
+        SampleBatch,
+    )
+
+    env = PendulumEnv(num_envs=16, seed=0)
+    pol = SACPolicy(
+        obs_shape=(3,),
+        act_dim=1,
+        action_low=env.action_space.low,
+        action_high=env.action_space.high,
+        hidden=(128, 128),
+        seed=0,
+    )
+    buf = ReplayBuffer(100_000, seed=0)
+    obs = env.reset(seed=0)
+    ep_rew = np.zeros(16)
+    ep_hist = []
+    rng = np.random.default_rng(0)
+    for it in range(900):
+        if len(buf) < 1000:
+            raw = rng.uniform(-1, 1, (16, 1)).astype(np.float32)
+            env_a = pol._center + pol._scale * raw
+        else:
+            env_a, raw = pol.compute_actions(obs)
+        nobs, rew, done, _ = env.step(env_a)
+        buf.add(
+            SampleBatch(
+                {OBS: obs, ACTIONS: raw, REWARDS: rew, NEXT_OBS: nobs,
+                 DONES: done.astype(np.float32)}
+            )
+        )
+        ep_rew += rew
+        for i in np.nonzero(done)[0]:
+            ep_hist.append(ep_rew[i])
+            ep_rew[i] = 0.0
+        obs = nobs
+        if len(buf) >= 1000:
+            for _ in range(8):
+                metrics = pol.learn_on_batch(buf.sample(128))
+    first = float(np.mean(ep_hist[:10]))
+    last = float(np.mean(ep_hist[-20:]))
+    assert last > first + 400, f"no learning: first10={first:.0f} last20={last:.0f}"
+    assert metrics["alpha"] > 0
+    assert np.isfinite(metrics["critic_loss"])
+
+
+def test_sac_algorithm_end_to_end(ray_cluster):
+    """The SAC Algorithm loop through real rollout actors: buffer fills,
+    gradient updates run, metrics flow."""
+    from ray_tpu import rllib
+    from ray_tpu.rllib.env import PendulumEnv
+
+    config = (
+        rllib.SACConfig()
+        .environment(lambda: PendulumEnv(num_envs=8, seed=0))
+        .rollouts(num_rollout_workers=1, num_envs_per_worker=8)
+        .training(
+            learning_starts=200,
+            train_batch_size=64,
+            num_train_per_iter=4,
+            rollout_fragment_length=200,
+            hidden=(32, 32),
+        )
+    )
+    algo = config.build()
+    try:
+        r1 = algo.train()
+        r2 = algo.train()
+        assert r2["timesteps_total"] > r1["timesteps_total"] >= 200
+        assert r2["num_grad_updates"] == 4
+        assert "critic_loss" in r2 and np.isfinite(r2["critic_loss"])
+        assert r2["episodes_total"] >= 0
+    finally:
+        algo.stop()
