@@ -132,21 +132,34 @@ class TPESearcher(Searcher):
         ]
 
     def _density(self, cfg, group) -> float:
-        """Product of per-dim Gaussian KDEs over the group's configs."""
+        """Log-density of cfg under the group's configs: per-dim Gaussian
+        KDE for numeric domains (log-space for LogUniform, matching how
+        the domain itself samples) plus smoothed categorical frequencies
+        for Choice domains."""
         import math
 
-        keys = self._numeric_keys()
-        if not group or not keys:
+        if not group:
             return 1.0
         logp = 0.0
-        for k in keys:
-            vals = [float(c[k]) for _, c in group]
-            x = float(cfg[k])
+        for k in self._numeric_keys():
+            log_space = isinstance(self.space[k], LogUniform)
+            xf = (lambda v: math.log(max(float(v), 1e-300))) if log_space else float
+            # tolerate partial configs (e.g. an errored trial recorded
+            # before its searcher suggested every key)
+            vals = [xf(c[k]) for _, c in group if k in c]
+            if not vals:
+                continue
+            x = xf(cfg[k])
             spread = max((max(vals) - min(vals)) / 2.0, 1e-9)
             p = sum(
                 math.exp(-(((x - v) / spread) ** 2) / 2.0) for v in vals
             ) / (len(vals) * spread)
             logp += math.log(max(p, 1e-12))
+        for k, dom in self.space.items():
+            if isinstance(dom, Choice):
+                n_cat = max(len(dom.categories), 1)
+                count = sum(1 for _, c in group if c.get(k) == cfg[k])
+                logp += math.log((count + 1.0) / (len(group) + n_cat))
         return logp
 
     def suggest(self, trial_id: str) -> Dict[str, Any]:
@@ -169,6 +182,35 @@ class TPESearcher(Searcher):
         if self.metric in metrics:
             # remember the config actually run (numeric keys only needed)
             self._results.append((float(metrics[self.metric]), dict(metrics.get("config") or {})))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from the wrapped searcher (reference:
+    tune/search/concurrency_limiter.py): a model-based searcher learns
+    nothing from trials that haven't finished, so unbounded parallelism
+    degrades it to random search.  suggest() returns None while
+    ``max_concurrent`` suggestions are outstanding — the trial loop keeps
+    the trial pending and retries after the next completion."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 4):
+        self.searcher = searcher
+        self.max_concurrent = int(max_concurrent)
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, param_space):
+        self.searcher.set_search_properties(metric, mode, param_space)
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, metrics: Dict[str, Any]):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, metrics)
 
 
 def generate_variants(

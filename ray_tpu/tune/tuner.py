@@ -220,9 +220,14 @@ class Tuner:
             for t in trials:
                 scheduler.on_trial_add(t.trial_id, t.config)
 
-        def _start_trial(trial: Trial, checkpoint=None):
+        def _start_trial(trial: Trial, checkpoint=None) -> bool:
             if searcher is not None and not trial.config:
-                trial.config = searcher.suggest(trial.trial_id)
+                suggested = searcher.suggest(trial.trial_id)
+                if suggested is None:
+                    # ConcurrencyLimiter: searcher wants to see more
+                    # completions first — leave the trial pending
+                    return False
+                trial.config = suggested
                 if hasattr(scheduler, "on_trial_add"):
                     scheduler.on_trial_add(trial.trial_id, trial.config)
             trial.actor = actor_cls.options(
@@ -235,12 +240,15 @@ class Tuner:
                 trial.actor.start.remote(self.trainable, checkpoint), timeout=120
             )
             trial.state = "RUNNING"
+            return True
 
         while pending or running:
             while pending and len(running) < tc.max_concurrent_trials:
                 trial = pending.pop(0)
                 # restored trials resume from their last checkpoint
-                _start_trial(trial, checkpoint=trial.latest_checkpoint)
+                if not _start_trial(trial, checkpoint=trial.latest_checkpoint):
+                    pending.insert(0, trial)
+                    break
                 running.append(trial)
 
             mutated = False
@@ -261,6 +269,14 @@ class Tuner:
                     if decision == STOP:
                         ray_tpu.get(trial.actor.stop.remote(), timeout=30)
                         trial.state = "STOPPED"
+                        if searcher is not None:
+                            # a pruned trial still completes for the
+                            # searcher: report its last result and free
+                            # any ConcurrencyLimiter slot
+                            searcher.on_trial_complete(
+                                trial.trial_id,
+                                {**trial.last_metrics, "config": trial.config},
+                            )
                         ray_tpu.kill(trial.actor)
                         running.remove(trial)
                     elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
@@ -277,16 +293,26 @@ class Tuner:
                         )
                 elif kind == "done":
                     trial.state = "TERMINATED"
-                    if searcher is not None and trial.last_metrics:
+                    if searcher is not None:
+                        # always notify (even with no reported metrics) so
+                        # a ConcurrencyLimiter slot can never leak
                         searcher.on_trial_complete(
                             trial.trial_id,
-                            {**trial.last_metrics, "config": trial.config},
+                            {**(trial.last_metrics or {}), "config": trial.config},
                         )
                     ray_tpu.kill(trial.actor)
                     running.remove(trial)
                 elif kind == "error":
                     trial.state = "ERROR"
                     trial.error = payload
+                    if searcher is not None:
+                        # free the searcher's concurrency slot; include the
+                        # config so a searcher that records the partial
+                        # result never stores an empty one
+                        searcher.on_trial_complete(
+                            trial.trial_id,
+                            {**(trial.last_metrics or {}), "config": trial.config},
+                        )
                     ray_tpu.kill(trial.actor)
                     running.remove(trial)
             if mutated:

@@ -195,3 +195,85 @@ def test_tpe_searcher_concentrates(ray_cluster):
     startup_err = sum(abs(x - 3.0) for x in xs[:6]) / 6
     late_err = sum(abs(x - 3.0) for x in xs[-6:]) / 6
     assert late_err < startup_err, (startup_err, late_err)
+
+
+def test_tpe_beats_random_on_noisy_objective():
+    """Same trial budget, same noisy objective, deterministic seeds: TPE
+    must find a better optimum than random search (VERDICT r4 #3 'done'
+    criterion).  Runs the Searcher protocol directly — no cluster — so
+    the comparison is exact and fast."""
+    import random as pyrandom
+
+    from ray_tpu import tune
+    from ray_tpu.tune.search import TPESearcher
+
+    def run_search(searcher, budget=40, seed=123):
+        """Sequential suggest → observe loop over a noisy 2-D bowl with a
+        log-scaled lr axis; returns best TRUE (noise-free) value seen."""
+        noise = pyrandom.Random(seed)
+        space = {
+            "x": tune.uniform(-10.0, 10.0),
+            "lr": tune.loguniform(1e-5, 1.0),
+        }
+        searcher.set_search_properties("loss", "min", space)
+        import math
+
+        best_true = float("inf")
+        for i in range(budget):
+            cfg = searcher.suggest(f"t{i}")
+            true = (cfg["x"] - 3.0) ** 2 + (math.log10(cfg["lr"]) + 2.0) ** 2
+            observed = true + noise.gauss(0.0, 1.0)
+            searcher.on_trial_complete(
+                f"t{i}", {"loss": observed, "config": cfg}
+            )
+            best_true = min(best_true, true)
+        return best_true
+
+    class RandomSearcher(TPESearcher):
+        def suggest(self, trial_id):
+            return self._random_config()
+
+    tpe_best = run_search(TPESearcher(n_startup=10, seed=7))
+    rnd_best = run_search(RandomSearcher(seed=7))
+    assert tpe_best < rnd_best, (tpe_best, rnd_best)
+
+
+def test_concurrency_limiter_caps_inflight_suggestions():
+    from ray_tpu import tune
+    from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
+
+    limiter = ConcurrencyLimiter(TPESearcher(seed=0), max_concurrent=2)
+    limiter.set_search_properties("loss", "min", {"x": tune.uniform(0, 1)})
+    a = limiter.suggest("a")
+    b = limiter.suggest("b")
+    assert a is not None and b is not None
+    assert limiter.suggest("c") is None  # capped
+    limiter.on_trial_complete("a", {"loss": 1.0, "config": a})
+    c = limiter.suggest("c")
+    assert c is not None  # slot freed
+
+
+def test_concurrency_limiter_through_tuner(ray_cluster):
+    """A limiter tighter than max_concurrent_trials throttles trial
+    starts without deadlocking the trial loop."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
+    from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+    def objective(config):
+        from ray_tpu.air import session
+
+        session.report({"loss": (config["x"] - 1.0) ** 2})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-5.0, 5.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            max_concurrent_trials=4,
+            searcher=ConcurrencyLimiter(TPESearcher(n_startup=3, seed=0), max_concurrent=2),
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid.trials) == 8
+    assert all(t.state == "TERMINATED" for t in grid.trials)
