@@ -9,10 +9,13 @@ reach.  Rendezvous goes through the head's KV (the reference used a named
 NCCLUniqueIDStore actor, collective_group/util.py:9; GCS KV is the
 centralized equivalent, exactly what SURVEY §2.4 prescribes).
 
-Topology: rank 0 listens; all ranks build a ring (rank i connects to
-(i+1) % n).  Algorithms: ring allreduce (reduce-scatter + allgather over
+Topology: every rank listens; rank i connects to (i+1) % n forming a
+ring.  Algorithms: ring allreduce (reduce-scatter + allgather over
 chunks), ring allgather, tree broadcast via ring rotation — bandwidth
-optimal for large tensors over slow links.
+optimal for large tensors over slow links.  Arbitrary-pair send/recv
+(reference: util/collective/collective.py:531,594) dials direct cached
+connections through the same rendezvous addresses, admitted by a
+standing accept loop.
 """
 
 from __future__ import annotations
@@ -238,8 +241,17 @@ class DcnGroup:
         self._prev_sock: Optional[socket.socket] = None
         self._listener: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # arbitrary-pair p2p: dial-by-rank connections on demand (the
+        # rendezvous already publishes every rank's addr), accepted by a
+        # standing thread for the group's lifetime
+        self._p2p_out: Dict[int, socket.socket] = {}
+        self._p2p_in: Dict[int, socket.socket] = {}
+        self._p2p_cv = threading.Condition()
+        self._p2p_token: Optional[str] = None
+        self._closed = False
         if world_size > 1:
             self._build_ring()
+            threading.Thread(target=self._p2p_accept_loop, daemon=True).start()
 
     # ------------------------------------------------------------- topology
 
@@ -267,6 +279,7 @@ class DcnGroup:
         # route-based self-discovery, else loopback (single-host)
         host = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
         token = secrets.token_hex(16)
+        self._p2p_token = token  # p2p dialers prove KV access with OUR token
         self._kv.kv_put(self._token_key(self.rank), token.encode())
         self._kv.kv_put(self._kv_key(self.rank), f"{host}:{port}".encode())
 
@@ -343,6 +356,87 @@ class DcnGroup:
     def recv_prev(self) -> np.ndarray:
         return _recv_array(self._prev_sock)
 
+    # -------------------------------------------------------- arbitrary p2p
+
+    def _p2p_accept_loop(self):
+        """Standing accept loop for the group's lifetime: admits dial-by-
+        rank p2p connections (hello: p2p\\n<group>\\n<src>\\n<our token>,
+        acked with "ok" so the dialer knows it wasn't consumed by a stray
+        ring-build accept) and registers them by source rank."""
+        listener = self._listener
+        if listener is None:
+            return
+        listener.settimeout(1.0)
+        while not self._closed:
+            try:
+                sock, _ = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(5)
+                parts = _recv_bounded_msg(sock, max_len=4096).decode().split("\n")
+                if (
+                    len(parts) == 4
+                    and parts[0] == "p2p"
+                    and parts[1] == self.group_name
+                    and parts[3] == self._p2p_token
+                ):
+                    src = int(parts[2])
+                    sock.settimeout(None)
+                    _send_msg(sock, b"ok")
+                    with self._p2p_cv:
+                        old = self._p2p_in.pop(src, None)
+                        self._p2p_in[src] = sock
+                        self._p2p_cv.notify_all()
+                    if old is not None:
+                        old.close()
+                else:
+                    sock.close()
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _p2p_connect(self, dst_rank: int) -> socket.socket:
+        """Get-or-dial a direct connection to dst_rank (cached).  Retries
+        until the destination's standing accept loop admits us — a dial
+        racing the ring build may be consumed and closed there."""
+        sock = self._p2p_out.get(dst_rank)
+        if sock is not None:
+            return sock
+        addr = self._kv.kv_get(self._kv_key(dst_rank), wait=True, timeout=120)
+        token = self._kv.kv_get(self._token_key(dst_rank), wait=True, timeout=120)
+        if addr is None or token is None:
+            raise TimeoutError(f"p2p rendezvous timed out for rank {dst_rank}")
+        host, port = addr.decode().rsplit(":", 1)
+        hello = f"p2p\n{self.group_name}\n{self.rank}\n{token.decode()}".encode()
+        deadline = time.time() + 120
+        while True:
+            s = None
+            try:
+                s = socket.create_connection((host, int(port)), timeout=10)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _send_msg(s, hello)
+                s.settimeout(10)
+                if _recv_bounded_msg(s, max_len=16) == b"ok":
+                    s.settimeout(None)
+                    self._p2p_out[dst_rank] = s
+                    return s
+                s.close()
+            except (OSError, ConnectionError):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            if time.time() > deadline:
+                raise TimeoutError(f"p2p connect to rank {dst_rank} timed out")
+            time.sleep(0.1)
+
     # ----------------------------------------------------------- collectives
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
@@ -414,25 +508,42 @@ class DcnGroup:
         self.allreduce(np.zeros(1, dtype=np.float32))
 
     def send(self, arr: np.ndarray, dst_rank: int):
-        """Point-to-point via ring forwarding (ranks between must be in
-        recv-forward; use ring-neighbor sends for performance paths)."""
+        """Point-to-point send to ANY rank (reference analog:
+        util/collective/collective.py:531 send).  Ring neighbors reuse the
+        ring link (zero extra connections on the hot path); other pairs
+        dial a direct cached connection via the rendezvous addresses."""
+        if dst_rank == self.rank:
+            raise ValueError("p2p send to self")
         if dst_rank == (self.rank + 1) % self.world_size:
             with self._lock:
                 self.send_next(arr)
         else:
-            raise NotImplementedError(
-                "DCN p2p supports ring-neighbor send; arbitrary pairs connect "
-                "via a dedicated group"
-            )
+            _send_array(self._p2p_connect(dst_rank), arr)
 
     def recv(self, src_rank: int) -> np.ndarray:
+        """Point-to-point receive from ANY rank (reference analog:
+        util/collective/collective.py:594 recv)."""
+        if src_rank == self.rank:
+            raise ValueError("p2p recv from self")
         if src_rank == (self.rank - 1) % self.world_size:
             with self._lock:
                 return self.recv_prev()
-        raise NotImplementedError("DCN p2p supports ring-neighbor recv")
+        deadline = time.time() + 120
+        with self._p2p_cv:
+            while src_rank not in self._p2p_in:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._p2p_cv.wait(min(remaining, 5.0)):
+                    if time.time() > deadline:
+                        raise TimeoutError(f"p2p recv: rank {src_rank} never connected")
+            sock = self._p2p_in[src_rank]
+        return _recv_array(sock)
 
     def destroy(self):
-        for s in (self._next_sock, self._prev_sock, self._listener):
+        self._closed = True
+        with self._p2p_cv:
+            # snapshot under the cv: the accept loop mutates _p2p_in
+            p2p = list(self._p2p_out.values()) + list(self._p2p_in.values())
+        for s in (self._next_sock, self._prev_sock, self._listener, *p2p):
             if s is not None:
                 try:
                     s.close()
