@@ -1,0 +1,153 @@
+// Native unit tests for the fixed-point scheduler core (reference analog:
+// scheduling-policy unit tests run under the sanitizer configs in
+// .bazelrc:92-102).  Built and run by tests/test_native.py under
+// -fsanitize=address and -fsanitize=thread.
+
+#include "scheduler.cc"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, __LINE__,      \
+              #cond);                                                      \
+      abort();                                                             \
+    }                                                                      \
+  } while (0)
+
+namespace {
+
+constexpr int64_t FP = 10000;  // kScale
+
+void test_accounting() {
+  void* s = sched_create();
+  int64_t totals[2] = {4 * FP, 1 * FP};  // 4 CPU, 1 TPU
+  CHECK(sched_upsert_node(s, 0, totals, 2) == 0);
+  int64_t demand[2] = {2 * FP, 0};
+  CHECK(sched_acquire(s, 0, demand, 2) == 0);
+  int64_t avail[2] = {0, 0};
+  sched_available(s, 0, avail, 2);
+  CHECK(avail[0] == 2 * FP && avail[1] == 1 * FP);
+  CHECK(sched_utilization(s, 0) == FP / 2);  // 50% on CPU axis
+  // insufficient
+  int64_t big[2] = {3 * FP, 0};
+  CHECK(sched_acquire(s, 0, big, 2) == -1);
+  // release clamps at total
+  int64_t huge[2] = {100 * FP, 100 * FP};
+  sched_release(s, 0, huge, 2);
+  sched_available(s, 0, avail, 2);
+  CHECK(avail[0] == 4 * FP && avail[1] == 1 * FP);
+  // force-acquire oversubscribes (blocked-task re-acquire path)
+  sched_acquire_force(s, 0, huge, 2);
+  sched_available(s, 0, avail, 2);
+  CHECK(avail[0] == 4 * FP - 100 * FP);
+  sched_destroy(s);
+  fprintf(stderr, "test_accounting OK\n");
+}
+
+void test_hybrid_pack_then_spread() {
+  void* s = sched_create();
+  int64_t totals[1] = {10 * FP};
+  CHECK(sched_upsert_node(s, 0, totals, 1) == 0);
+  CHECK(sched_upsert_node(s, 1, totals, 1) == 0);
+  // node0 at 20%, node1 at 50%
+  int64_t d2[1] = {2 * FP}, d5[1] = {5 * FP};
+  CHECK(sched_acquire(s, 0, d2, 1) == 0);
+  CHECK(sched_acquire(s, 1, d5, 1) == 0);
+  // below the 70% threshold both are packable: MOST utilized (node1) wins
+  int64_t d1[1] = {1 * FP};
+  CHECK(sched_pick_and_acquire(s, d1, 1, 7000, -1) == 1);
+  // push node1 over the threshold: utilization 60%+... fill to 90%
+  int64_t d3[1] = {3 * FP};
+  CHECK(sched_acquire(s, 1, d3, 1) == 0);  // node1 now 90%
+  // node1 >= threshold, node0 (20%) below: pack picks node0
+  CHECK(sched_pick_and_acquire(s, d1, 1, 7000, -1) == 0);
+  sched_destroy(s);
+  fprintf(stderr, "test_hybrid_pack_then_spread OK\n");
+}
+
+void test_spread_when_all_above_threshold() {
+  void* s = sched_create();
+  int64_t totals[1] = {10 * FP};
+  CHECK(sched_upsert_node(s, 0, totals, 1) == 0);
+  CHECK(sched_upsert_node(s, 1, totals, 1) == 0);
+  int64_t d8[1] = {8 * FP}, d9[1] = {9 * FP};
+  CHECK(sched_acquire(s, 0, d8, 1) == 0);  // 80%
+  CHECK(sched_acquire(s, 1, d9, 1) == 0);  // 90%
+  // both above a 50% threshold: spread to LEAST utilized (node0)
+  int64_t d1[1] = {1 * FP};
+  CHECK(sched_pick_and_acquire(s, d1, 1, 5000, -1) == 0);
+  sched_destroy(s);
+  fprintf(stderr, "test_spread_when_all_above_threshold OK\n");
+}
+
+void test_prefer_and_feasible_and_dead() {
+  void* s = sched_create();
+  int64_t totals[1] = {4 * FP};
+  CHECK(sched_upsert_node(s, 0, totals, 1) == 0);
+  CHECK(sched_upsert_node(s, 1, totals, 1) == 0);
+  int64_t d1[1] = {1 * FP};
+  // equal utilization: prefer_idx breaks the tie
+  CHECK(sched_pick_and_acquire(s, d1, 1, 7000, 1) == 1);
+  // feasibility looks at TOTALS, not current availability
+  int64_t d6[1] = {6 * FP};
+  CHECK(sched_feasible(s, d6, 1) == 0);
+  int64_t d4[1] = {4 * FP};
+  CHECK(sched_feasible(s, d4, 1) == 1);
+  // dead nodes are invisible
+  CHECK(sched_remove_node(s, 0) == 0);
+  CHECK(sched_remove_node(s, 1) == 0);
+  CHECK(sched_pick_and_acquire(s, d1, 1, 7000, -1) == -1);
+  CHECK(sched_feasible(s, d4, 1) == 0);
+  sched_destroy(s);
+  fprintf(stderr, "test_prefer_and_feasible_and_dead OK\n");
+}
+
+void test_concurrent_acquire_release() {
+  void* s = sched_create();
+  int64_t totals[1] = {1000 * FP};
+  CHECK(sched_upsert_node(s, 0, totals, 1) == 0);
+  CHECK(sched_upsert_node(s, 1, totals, 1) == 0);
+  std::atomic<int> acquired{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back([s, &acquired]() {
+      int64_t d[1] = {1 * FP};
+      for (int i = 0; i < 2000; i++) {
+        int node = sched_pick_and_acquire(s, d, 1, 7000, -1);
+        if (node >= 0) {
+          acquired++;
+          sched_release(s, node, d, 1);
+          acquired--;
+        }
+        if (i % 100 == 0) sched_utilization(s, node >= 0 ? node : 0);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK(acquired.load() == 0);
+  // all reservations returned: both nodes fully available
+  int64_t avail[1];
+  sched_available(s, 0, avail, 1);
+  int64_t a0 = avail[0];
+  sched_available(s, 1, avail, 1);
+  CHECK(a0 == 1000 * FP && avail[0] == 1000 * FP);
+  sched_destroy(s);
+  fprintf(stderr, "test_concurrent_acquire_release OK\n");
+}
+
+}  // namespace
+
+int main() {
+  test_accounting();
+  test_hybrid_pack_then_spread();
+  test_spread_when_all_above_threshold();
+  test_prefer_and_feasible_and_dead();
+  test_concurrent_acquire_release();
+  fprintf(stderr, "scheduler_test: ALL OK\n");
+  return 0;
+}
