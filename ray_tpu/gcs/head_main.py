@@ -57,7 +57,20 @@ def main():
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
         stream=sys.stderr,
     )
-    asyncio.run(_amain(args))
+    profile_path = os.environ.get("RAY_TPU_HEAD_PROFILE", "")
+    if profile_path:
+        # dev/perf diagnosis: profile the head's event loop, dump on exit
+        import cProfile
+
+        pr = cProfile.Profile()
+        pr.enable()
+        try:
+            asyncio.run(_amain(args))
+        finally:
+            pr.disable()
+            pr.dump_stats(profile_path)
+    else:
+        asyncio.run(_amain(args))
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 from typing import List
 
 from ray_tpu._private.config import RayConfig
@@ -34,6 +35,11 @@ class Raylet:
         self.node_id = NodeID.from_random()
         self.store_path = os.path.join(session_dir, f"store-{self.node_id.hex()[:8]}")
         self.worker_procs: List[subprocess.Popen] = []
+        self.worker_pids: List[int] = []  # zygote-forked workers
+        self._zygote = None
+        # spawns run on executor threads (off the read loop): serialize
+        # seq/zygote mutation
+        self._spawn_lock = threading.Lock()
         self._worker_seq = 0
         self.store = None
         self.object_agent = None
@@ -168,7 +174,10 @@ class Raylet:
                 if conn.dispatch_reply(msg_type, rid, payload):
                     continue
                 if msg_type == MsgType.PUSH_TASK and payload.get("directive") == "spawn_worker":
-                    self._spawn_worker(tpu=bool(payload.get("tpu")))
+                    # blocking zygote/exec work off the read loop
+                    asyncio.get_running_loop().run_in_executor(
+                        None, self._spawn_worker, bool(payload.get("tpu"))
+                    )
                 elif msg_type == MsgType.OBJECT_PULL:
                     asyncio.get_running_loop().create_task(
                         self._handle_pull(conn, rid, payload)
@@ -220,6 +229,10 @@ class Raylet:
             pass
 
     def _spawn_worker(self, tpu: bool = False):
+        with self._spawn_lock:
+            self._spawn_worker_locked(tpu)
+
+    def _spawn_worker_locked(self, tpu: bool = False):
         self._worker_seq += 1
         env = dict(os.environ)
         env["RAY_TPU_HEAD"] = f"{self.head_host}:{self.head_port}"
@@ -235,6 +248,23 @@ class Raylet:
         log = os.path.join(
             self.session_dir, f"worker-{self.node_id.hex()[:8]}-{self._worker_seq}.log"
         )
+        if not tpu:
+            # pool workers fork from the warm zygote (~30ms vs ~1s exec);
+            # TPU workers keep exec — their claim env must exist at
+            # interpreter start (sitecustomize)
+            if self._zygote is None:
+                from ray_tpu._private.zygote import ZygoteSpawner
+
+                self._zygote = ZygoteSpawner(
+                    dict(env),
+                    os.path.join(
+                        self.session_dir, f"zygote-{self.node_id.hex()[:8]}.log"
+                    ),
+                )
+            pid = self._zygote.spawn(env, log)
+            if pid is not None:
+                self.worker_pids.append(pid)
+                return
         with open(log, "ab") as logf:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker_main"],
@@ -250,6 +280,13 @@ class Raylet:
                 proc.terminate()
             except OSError:
                 pass
+        for pid in self.worker_pids:
+            try:
+                os.kill(pid, 15)
+            except OSError:
+                pass
+        if self._zygote is not None:
+            self._zygote.stop()
 
     def shutdown(self):
         self.kill_workers()
