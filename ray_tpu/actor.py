@@ -120,6 +120,17 @@ class ActorClass:
         if pg is not None:
             pg_id = pg.id if isinstance(pg.id, bytes) else pg.id.binary()
         scheduling_strategy = opts.get("scheduling_strategy")
+        node_affinity = None
+        if scheduling_strategy is not None and hasattr(scheduling_strategy, "node_id"):
+            # NodeAffinitySchedulingStrategy (reference:
+            # util/scheduling_strategies.py) — pin the actor to a node
+            if getattr(scheduling_strategy, "soft", False):
+                raise ValueError(
+                    "NodeAffinitySchedulingStrategy(soft=True) is not "
+                    "supported: affinity here is a hard pin (a soft task "
+                    "would silently hang pinned to a dead node)"
+                )
+            node_affinity = bytes.fromhex(scheduling_strategy.node_id)
         if scheduling_strategy is not None and hasattr(scheduling_strategy, "placement_group"):
             spg = scheduling_strategy.placement_group
             if spg is not None:
@@ -148,5 +159,6 @@ class ActorClass:
             pg_id=pg_id,
             pg_bundle_index=bundle_index,
             runtime_env=opts.get("runtime_env"),
+            node_affinity=node_affinity,
         )
         return ActorHandle(actor_id, self._cls.__name__, self._function_id, cw)

@@ -883,6 +883,7 @@ class CoreWorker:
         pg_bundle_index: int,
         runtime_env: Optional[dict] = None,
         implicit_cpu: bool = False,
+        node_affinity: Optional[bytes] = None,
     ) -> ObjectRef:
         from ray_tpu._private.ids import ActorID
 
@@ -912,6 +913,7 @@ class CoreWorker:
             detached=detached,
             pg_id=pg_id,
             pg_bundle_index=pg_bundle_index,
+            node_affinity=node_affinity,
             caller_id=self.worker_id.binary(),
             trace_ctx=_new_span(),
             runtime_env=runtime_env or {},

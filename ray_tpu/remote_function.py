@@ -74,6 +74,12 @@ class RemoteFunction:
         scheduling_strategy = opts.get("scheduling_strategy")
         node_affinity = None
         if scheduling_strategy is not None and hasattr(scheduling_strategy, "node_id"):
+            if getattr(scheduling_strategy, "soft", False):
+                raise ValueError(
+                    "NodeAffinitySchedulingStrategy(soft=True) is not "
+                    "supported: affinity here is a hard pin (a soft task "
+                    "would silently hang pinned to a dead node)"
+                )
             node_affinity = bytes.fromhex(scheduling_strategy.node_id)
             if getattr(scheduling_strategy, "placement_group", None):
                 pass

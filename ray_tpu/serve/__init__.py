@@ -5,6 +5,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_deployment_handle,
     list_deployments,
+    proxy_addresses,
     run,
     shutdown,
     start_http_proxy,

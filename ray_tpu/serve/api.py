@@ -25,6 +25,10 @@ class Deployment:
     ray_actor_options: Optional[dict] = None
     autoscaling_config: Optional[dict] = None
     max_concurrent_queries: int = 100
+    # plain-data config delivered to the instance's reconfigure() — at
+    # construction AND in place on redeploys that change only this field
+    # (reference: serve deployment user_config lightweight updates)
+    user_config: Optional[dict] = None
 
     def bind(self, *args, **kwargs) -> "Deployment":
         import dataclasses
@@ -109,6 +113,7 @@ def run(deployment_obj: Deployment, *, _blocking: bool = False, http_port: Optio
             deployment_obj.autoscaling_config,
             deployment_obj.max_concurrent_queries,
             def_version,
+            deployment_obj.user_config,
         ),
         timeout=300,
     )
@@ -148,6 +153,13 @@ def delete(name: str):
 def shutdown():
     import ray_tpu
 
+    for h in _proxy_handles.values():
+        try:
+            ray_tpu.kill(h)
+        except Exception:
+            pass
+    _proxy_handles.clear()
+    _proxy_urls.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
@@ -158,11 +170,15 @@ def shutdown():
 
 
 class HTTPProxy:
-    """aiohttp ingress actor (reference: _private/http_proxy.py:189)."""
+    """aiohttp ingress actor, one per node (reference:
+    _private/http_proxy.py:189,333 — per-node proxies behind the cluster
+    LB).  Its DeploymentHandles route local-first: replicas on the
+    proxy's own node are preferred (handle.py _pick_replica)."""
 
     def __init__(self, port: int):
         self.port = port
         self._handles = {}
+        self.url = None
 
     async def start(self):
         import json
@@ -210,21 +226,65 @@ class HTTPProxy:
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", self.port)
         await site.start()
-        return f"http://127.0.0.1:{self.port}"
+        actual = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{actual}"
+        return self.url
 
     async def ping(self):
         return "ok"
 
 
-_proxy_handle = None
+_proxy_handles: Dict[str, Any] = {}
+_proxy_urls: Dict[str, str] = {}
 
 
 def start_http_proxy(port: int = 8000) -> str:
-    global _proxy_handle
+    """Start HTTP ingress: one proxy actor PER ALIVE NODE, each pinned by
+    node affinity and routing to its own node's replicas first (reference:
+    _private/http_proxy.py — per-node proxies).  The driver's node binds
+    ``port``; other nodes bind an ephemeral port (this runtime's test
+    clusters share one host, where a fixed port would collide).  Returns
+    the driver-node proxy's URL; all of them via proxy_addresses()."""
     import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
-    if _proxy_handle is None:
-        cls = ray_tpu.remote(HTTPProxy)
-        _proxy_handle = cls.options(num_cpus=0, name="_serve_http_proxy").remote(port)
-        return ray_tpu.get(_proxy_handle.start.remote(), timeout=120)
-    return f"http://127.0.0.1:{port}"
+    my_node = bytes(worker_mod._require_connected().node_id).hex()
+    alive = {n["NodeID"] for n in ray_tpu.nodes() if n["Alive"]}
+    # reconcile the cached set against the CURRENT cluster: drop proxies
+    # on dead nodes (or from a previous cluster in this process — tests
+    # init/shutdown repeatedly), add proxies for newly-joined nodes
+    for nid in list(_proxy_handles):
+        stale = nid not in alive
+        if not stale:
+            try:
+                ray_tpu.get(_proxy_handles[nid].ping.remote(), timeout=10)
+            except Exception:
+                stale = True
+        if stale:
+            try:
+                ray_tpu.kill(_proxy_handles[nid])
+            except Exception:
+                pass
+            _proxy_handles.pop(nid, None)
+            _proxy_urls.pop(nid, None)
+    cls = ray_tpu.remote(HTTPProxy)
+    started = []
+    for nid in alive:
+        if nid in _proxy_handles:
+            continue
+        h = cls.options(
+            num_cpus=0,
+            name=f"_serve_http_proxy::{nid}",
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+        ).remote(port if nid == my_node else 0)
+        _proxy_handles[nid] = h
+        started.append(nid)
+    for nid in started:
+        _proxy_urls[nid] = ray_tpu.get(_proxy_handles[nid].start.remote(), timeout=120)
+    return _proxy_urls.get(my_node) or next(iter(_proxy_urls.values()))
+
+
+def proxy_addresses() -> Dict[str, str]:
+    """node id (hex) → that node's proxy URL."""
+    return dict(_proxy_urls)

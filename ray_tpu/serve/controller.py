@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 class Replica:
     """Replica actor body: hosts the user callable."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None):
         import inspect
 
         if inspect.isclass(cls_or_fn):
@@ -26,6 +26,8 @@ class Replica:
             self.instance = cls_or_fn
         self.inflight = 0
         self.handled = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
 
     async def handle_request(self, method: str, args, kwargs):
         # async: the worker hosts this actor on an asyncio loop, so batched
@@ -49,9 +51,17 @@ class Replica:
         return {"inflight": self.inflight, "handled": self.handled}
 
     def reconfigure(self, user_config):
+        """Apply a user_config IN PLACE — no restart (reference:
+        serve/_private/replica.py reconfigure)."""
         if hasattr(self.instance, "reconfigure"):
             self.instance.reconfigure(user_config)
         return True
+
+    def node_id(self) -> str:
+        """Which node hosts this replica (locality-aware routing)."""
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "")
 
 
 class ServeController:
@@ -97,6 +107,7 @@ class ServeController:
                 "autoscaling": d["autoscaling"],
                 "max_concurrent_queries": d["max_concurrent_queries"],
                 "def_version": d.get("def_version", ""),
+                "user_config": d.get("user_config"),
                 "gen": d.get("gen", 0),
                 "rseq": d.get("rseq", 0),
                 "replica_names": list(d.get("replica_names", [])),
@@ -139,6 +150,7 @@ class ServeController:
                 "autoscaling": s["autoscaling"],
                 "max_concurrent_queries": s["max_concurrent_queries"],
                 "def_version": s.get("def_version", ""),
+                "user_config": s.get("user_config"),
                 "gen": s.get("gen", 0),
                 "rseq": s.get("rseq", 0),
                 "replicas": [],
@@ -190,6 +202,7 @@ class ServeController:
         autoscaling_config: Optional[dict],
         max_concurrent_queries: int,
         def_version: str = "",
+        user_config: Optional[dict] = None,
     ):
         import time as _time
 
@@ -197,6 +210,7 @@ class ServeController:
 
         dep = self.deployments.get(name)
         redeploy = False
+        reconfigure = False
         if dep is None:
             dep = {
                 "name": name,
@@ -213,8 +227,11 @@ class ServeController:
             # version-gated rolling update ONLY when the definition changed
             # (caller-computed hash — the objects we hold are deserialized
             # copies, so identity checks are meaningless here); a plain
-            # scale-up/down keeps warm replicas
+            # scale-up/down keeps warm replicas.  A user_config change
+            # alone RECONFIGURES live replicas in place — no restart
+            # (reference: deployment_state.py lightweight-update path)
             redeploy = bool(def_version) and dep.get("def_version") != def_version
+            reconfigure = not redeploy and dep.get("user_config") != user_config
         dep["target"] = num_replicas
         dep["cls"] = cls_or_fn
         dep["init_args"] = init_args
@@ -222,6 +239,7 @@ class ServeController:
         dep["actor_options"] = ray_actor_options or {}
         dep["max_concurrent_queries"] = max_concurrent_queries
         dep["def_version"] = def_version
+        dep["user_config"] = user_config
         if route_prefix is not None:
             dep["route_prefix"] = route_prefix
         dep["autoscaling"] = autoscaling_config
@@ -230,6 +248,34 @@ class ServeController:
             old = self._rolling_replace(name)
         else:
             self._reconcile(name)
+            if reconfigure and dep["replicas"]:
+                # per-replica: one wedged replica must not leave the set
+                # serving a silent old/new MIX — any replica that fails to
+                # acknowledge is killed and respawned (the fresh replica
+                # gets the new user_config at construction)
+                refs = [
+                    (r, r.reconfigure.remote(user_config)) for r in list(dep["replicas"])
+                ]
+                failed = []
+                for r, ref in refs:
+                    try:
+                        ray_tpu.get(ref, timeout=60)
+                    except Exception:
+                        failed.append(r)
+                for r in failed:
+                    try:
+                        idx = dep["replicas"].index(r)
+                    except ValueError:
+                        continue
+                    dep["replicas"].pop(idx)
+                    gone = dep["replica_names"].pop(idx)
+                    dep.get("replica_nodes", {}).pop(gone, None)
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                if failed:
+                    self._reconcile(name)
         self.version += 1
         self._checkpoint()
         self._publish_update(name)
@@ -295,9 +341,27 @@ class ServeController:
         opts = dict(dep["actor_options"])
         opts["name"] = rname
         handle = actor_cls.options(**opts).remote(
-            dep["cls"], dep["init_args"], dep["init_kwargs"]
+            dep["cls"], dep["init_args"], dep["init_kwargs"],
+            user_config=dep.get("user_config"),
         )
+        # resolve which node the replica landed on OFF the deploy path
+        # (construction may be slow); handles use it for local-first
+        # routing and converge via their pull fallback
+        import threading
+
+        threading.Thread(
+            target=self._resolve_replica_node, args=(dep, rname, handle), daemon=True
+        ).start()
         return handle, rname
+
+    def _resolve_replica_node(self, dep: dict, rname: str, handle):
+        import ray_tpu
+
+        try:
+            nid = ray_tpu.get(handle.node_id.remote(), timeout=300)
+        except Exception:
+            return
+        dep.setdefault("replica_nodes", {})[rname] = nid
 
     def _rolling_replace(self, name: str) -> list:
         """Spin up the new generation, wait until it answers, swap it in,
@@ -316,6 +380,10 @@ class ServeController:
             pass  # serve whatever came up; reconcile repairs stragglers
         old, dep["replicas"] = dep["replicas"], fresh
         dep["replica_names"] = [n for _, n in spawned]
+        live = set(dep["replica_names"])
+        dep["replica_nodes"] = {
+            k: v for k, v in dep.get("replica_nodes", {}).items() if k in live
+        }
         return old
 
     def _reconcile(self, name: str):
@@ -328,7 +396,8 @@ class ServeController:
             dep["replica_names"].append(rname)
         while len(dep["replicas"]) > dep["target"]:
             victim = dep["replicas"].pop()
-            dep["replica_names"].pop()
+            gone = dep["replica_names"].pop()
+            dep.get("replica_nodes", {}).pop(gone, None)
             try:
                 ray_tpu.kill(victim)
             except Exception:
@@ -338,8 +407,12 @@ class ServeController:
         dep = self.deployments.get(name)
         if dep is None:
             return None
+        nodes = dep.get("replica_nodes", {})
         return {
             "replicas": dep["replicas"],
+            # node hex per replica ("" while still resolving): handles
+            # prefer same-node replicas (per-node proxy local-first path)
+            "replica_nodes": [nodes.get(rn, "") for rn in dep["replica_names"]],
             "max_concurrent_queries": dep["max_concurrent_queries"],
             "version": self.version,
         }

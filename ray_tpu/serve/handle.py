@@ -36,6 +36,8 @@ class DeploymentHandle:
         self._name = deployment_name
         self._controller = controller
         self._replicas: List = []
+        self._replica_nodes: List[str] = []
+        self._my_node = self._resolve_my_node()
         self._max_inflight = 100
         self._version = -1
         self._rr = itertools.count()
@@ -95,6 +97,9 @@ class DeploymentHandle:
             # identity-keyed counters survive membership changes untouched;
             # drop entries for replicas that left the set
             self._replicas = info["replicas"]
+            self._replica_nodes = info.get("replica_nodes") or [""] * len(
+                self._replicas
+            )
             self._max_inflight = info["max_concurrent_queries"]
             self._version = info["version"]
             live = {self._rid(r) for r in self._replicas}
@@ -107,6 +112,20 @@ class DeploymentHandle:
     @staticmethod
     def _rid(replica):
         return getattr(replica, "_actor_id", id(replica))
+
+    @staticmethod
+    def _resolve_my_node() -> str:
+        import os
+
+        nid = os.environ.get("RAY_TPU_NODE_ID", "")
+        if nid:
+            return nid
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            return bytes(worker_mod._require_connected().node_id).hex()
+        except Exception:
+            return ""
 
     def _pick_replica(self):
         import time as _time
@@ -135,13 +154,25 @@ class DeploymentHandle:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(f"deployment {self._name} has no replicas")
-            # round-robin, skipping replicas at their in-flight cap
-            for _ in range(n):
-                idx = next(self._rr) % n
-                rid = self._rid(self._replicas[idx])
-                if self._inflight.get(rid, 0) < self._max_inflight:
-                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                    return rid, self._replicas[idx]
+            # local-first: replicas on THIS node get priority (per-node
+            # proxies route to their own node's replicas, reference:
+            # http_proxy.py prefer-local routing) — fall through to the
+            # whole set when no local replica is under its cap
+            pools = [list(range(n))]
+            if self._my_node and len(self._replica_nodes) == n:
+                local = [
+                    i for i in range(n) if self._replica_nodes[i] == self._my_node
+                ]
+                if local and len(local) < n:
+                    pools.insert(0, local)
+            for pool in pools:
+                # round-robin, skipping replicas at their in-flight cap
+                for _ in range(len(pool)):
+                    idx = pool[next(self._rr) % len(pool)]
+                    rid = self._rid(self._replicas[idx])
+                    if self._inflight.get(rid, 0) < self._max_inflight:
+                        self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                        return rid, self._replicas[idx]
             # all saturated: take the round-robin pick anyway (backpressure
             # belongs to the replica's queue)
             idx = next(self._rr) % n

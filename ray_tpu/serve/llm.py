@@ -20,7 +20,6 @@ Sharding layout (megatron-style, from LlamaModel.param_pspecs):
 
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
@@ -94,25 +93,55 @@ class ShardedLLM:
                 lambda x, sh: jax.device_put(x, sh), init, self.param_shardings
             )
         elif init == "cheap":
+            # deterministic per-shard numpy fill via make_array_from_callback
+            # — no XLA init program, no 2x cast transients; each device
+            # writes only ITS shard.  Values vary over the last two dims
+            # (broadcast over leading), which is non-degenerate enough to
+            # exercise every collective with real data at 7B shape on a
+            # 1-core dryrun host in tens of seconds.
+            import zlib
 
-            def cheap(_):
-                out = {}
+            def fill(path, s, sharding):
+                if "norm" in path:
+                    return jax.make_array_from_callback(
+                        s.shape,
+                        sharding,
+                        lambda idx: np.ones(
+                            tuple(
+                                len(range(*sl.indices(d)))
+                                for sl, d in zip(idx, s.shape)
+                            ),
+                            s.dtype,
+                        ),
+                    )
+                salt = zlib.crc32(path.encode())
 
-                def fill(path, s):
-                    if "norm" in path:
-                        return jnp.ones(s.shape, s.dtype)
-                    n = math.prod(s.shape)
-                    x = jax.lax.iota(jnp.float32, n).reshape(s.shape)
-                    return (((x % 1009.0) / 1009.0 - 0.5) * 0.04).astype(s.dtype)
-
-                for k, v in shapes.items():
-                    if isinstance(v, dict):
-                        out[k] = {k2: fill(k2, s) for k2, s in v.items()}
+                def cb(idx):
+                    sl = [range(*x.indices(d)) for x, d in zip(idx, s.shape)]
+                    shape = tuple(len(r) for r in sl)
+                    j = np.arange(sl[-1].start, sl[-1].stop, dtype=np.int64)
+                    col = ((j * 2654435761 + salt) % 1009) / 1009.0 - 0.5
+                    if len(shape) >= 2:
+                        i = np.arange(sl[-2].start, sl[-2].stop, dtype=np.int64)
+                        row = ((i * 40503 + salt) % 997) / 997.0 - 0.5
+                        mat = (col[None, :] + row[:, None]) * 0.02
                     else:
-                        out[k] = fill(k, v)
-                return out
+                        mat = col * 0.02
+                    out = np.broadcast_to(mat, shape).astype(s.dtype)
+                    return np.ascontiguousarray(out)
 
-            self.params = jax.jit(cheap, out_shardings=self.param_shardings)(0)
+                return jax.make_array_from_callback(s.shape, sharding, cb)
+
+            params = {}
+            for k, v in shapes.items():
+                if isinstance(v, dict):
+                    params[k] = {
+                        k2: fill(k2, s, self.param_shardings[k][k2])
+                        for k2, s in v.items()
+                    }
+                else:
+                    params[k] = fill(k, v, self.param_shardings[k])
+            self.params = params
         elif init == "random":
             self.params = jax.jit(
                 self.model.init, out_shardings=self.param_shardings

@@ -24,6 +24,10 @@ class DeploymentSchema:
     max_concurrent_queries: int = 100
     autoscaling_config: Optional[Dict[str, Any]] = None
     init_args: List[Any] = field(default_factory=list)
+    # delivered to the instance's reconfigure(); a config that changes
+    # ONLY this reconfigures live replicas in place, no restart
+    # (reference: serve schema user_config + lightweight updates)
+    user_config: Optional[Dict[str, Any]] = None
     # keys the config actually SET — apply() only overrides these, so a
     # decorator-declared route_prefix/num_replicas survives a config that
     # omits them
@@ -31,11 +35,6 @@ class DeploymentSchema:
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "DeploymentSchema":
-        if "user_config" in d:
-            raise ValueError(
-                "user_config is not supported yet (replica reconfigure is "
-                "not wired through the declarative path)"
-            )
         known = {f for f in DeploymentSchema.__dataclass_fields__} - {"present"}
         extra = set(d) - known
         if extra:
@@ -69,6 +68,7 @@ class ServeApplicationSchema:
                     "max_concurrent_queries": s.max_concurrent_queries,
                     "autoscaling_config": s.autoscaling_config,
                     "init_args": s.init_args,
+                    "user_config": s.user_config,
                 }
                 for s in self.deployments
             ]
@@ -103,6 +103,7 @@ def apply(config: Dict[str, Any]) -> Dict[str, Any]:
             "route_prefix",
             "max_concurrent_queries",
             "autoscaling_config",
+            "user_config",
         ):
             if key in d.present:
                 opts[key] = getattr(d, key)

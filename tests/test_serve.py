@@ -285,3 +285,118 @@ def test_declarative_schema_apply_and_rest(ray_cluster):
         serve_schema.ServeApplicationSchema.from_dict({"deployments": []})
     with _pytest.raises(ValueError):
         serve_schema.DeploymentSchema.from_dict({"name": "x", "import_path": "a:b", "bogus": 1})
+
+
+@serve.deployment(name="reconf")
+class _Reconfigurable:
+    def __init__(self):
+        import uuid
+
+        self.token = uuid.uuid4().hex  # changes iff the instance restarts
+        self.threshold = 0
+
+    def reconfigure(self, user_config):
+        self.threshold = user_config.get("threshold", 0)
+
+    def __call__(self, _x):
+        return {"token": self.token, "threshold": self.threshold}
+
+
+def test_user_config_reconfigures_live_replicas(ray_cluster):
+    """VERDICT r4 #9: user_config flows config → controller →
+    Replica.reconfigure, and a config change reconfigures LIVE replicas
+    without restarting them (reference: serve lightweight updates).
+
+    Both deploys go through the declarative path so the definition
+    resolves to the SAME class object (pytest imports this file as a
+    top-level module, so a decorator-path deploy and an import_path
+    deploy would pickle two distinct-but-equal classes and trigger a
+    legitimate definition-change rolling update instead)."""
+    from ray_tpu.serve import schema as serve_schema
+
+    def cfg(threshold):
+        return {
+            "deployments": [
+                {
+                    "name": "reconf",
+                    "import_path": "tests.test_serve:_Reconfigurable",
+                    "user_config": {"threshold": threshold},
+                }
+            ]
+        }
+
+    serve_schema.apply(cfg(5))
+    handle = serve.get_deployment_handle("reconf")
+    first = ray_tpu.get(handle.remote(0), timeout=120)
+    assert first["threshold"] == 5  # applied at construction
+
+    out = serve_schema.apply(cfg(9))  # REST shape: change ONLY user_config
+    assert out["applied"] == ["reconf"]
+    import time as _time
+
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        cur = ray_tpu.get(handle.remote(0), timeout=120)
+        if cur["threshold"] == 9:
+            break
+        _time.sleep(0.2)
+    assert cur["threshold"] == 9, cur
+    # SAME instance token: reconfigured in place, not restarted
+    assert cur["token"] == first["token"]
+
+
+def test_per_node_http_proxies(ray_cluster):
+    """One proxy actor per alive node (reference: _private/http_proxy.py
+    per-node proxies); each serves HTTP on its own port."""
+    import urllib.request
+    import json as _json
+
+    @serve.deployment(name="pp_echo")
+    def pp_echo(x):
+        return {"got": x}
+
+    serve.run(pp_echo.bind())
+    url = serve.start_http_proxy(18123)
+    addrs = serve.proxy_addresses()
+    nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+    assert len(addrs) == len(nodes)
+    assert url in addrs.values()
+    for u in addrs.values():
+        req = urllib.request.Request(
+            u + "/pp_echo", data=_json.dumps(7).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = _json.loads(resp.read())
+        assert body["result"] == {"got": 7}
+
+
+def test_handle_prefers_local_replicas():
+    """Local-first pick: with locality known, a handle on node A sends to
+    A's replica while it has capacity, and falls through when saturated."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h = DeploymentHandle.__new__(DeploymentHandle)  # no controller needed
+    import itertools
+    import threading
+
+    class FakeReplica:
+        def __init__(self, aid):
+            self._actor_id = aid
+
+    h._name = "t"
+    h._replicas = [FakeReplica(b"a"), FakeReplica(b"b")]
+    h._replica_nodes = ["node_a", "node_b"]
+    h._my_node = "node_b"
+    h._max_inflight = 2
+    h._version = 1
+    h._rr = itertools.count()
+    h._inflight = {}
+    h._lock = threading.Lock()
+    h._stale = threading.Event()
+    h._last_refresh = __import__("time").monotonic()
+    h._last_refresh_attempt = h._last_refresh
+
+    picks = [h._pick_replica()[0] for _ in range(2)]
+    assert picks == [b"b", b"b"]  # local replica preferred until its cap
+    assert h._pick_replica()[0] == b"a"  # local saturated: falls through
