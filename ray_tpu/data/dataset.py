@@ -51,6 +51,27 @@ def _map_block(fn, block):
 
 
 @ray_tpu.remote
+def _apply_fused(ops, block):
+    """ONE task applies a whole chained map/filter/map_batches pipeline to
+    a block — stage fusion (reference: data/_internal/plan.py:69
+    _optimize fused stages): k chained transforms cost one task + one
+    block ship per block, not k."""
+    from ray_tpu.data.block import batch_to_block, block_to_batch
+
+    for op in ops:
+        kind = op[0]
+        if kind == "map":
+            block = [op[1](row) for row in block_rows(block)]
+        elif kind == "filter":
+            block = [row for row in block_rows(block) if op[1](row)]
+        elif kind == "map_batches":
+            block = batch_to_block(op[1](block_to_batch(block, op[2])))
+        else:
+            raise ValueError(f"unknown fused op {kind!r}")
+    return block
+
+
+@ray_tpu.remote
 def _map_batch(fn, block, batch_format):
     return batch_to_block(fn(block_to_batch(block, batch_format)))
 
@@ -188,6 +209,45 @@ def _push_shuffle(part_refs: List[Any], n_parts: int, reduce_task, *reduce_args)
     return out
 
 
+def _prefetch_iter(blocks: List[ObjectRef], depth: int) -> Iterator[Any]:
+    """Yield resolved blocks in order while a daemon thread fetches up to
+    ``depth`` ahead through a bounded queue."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def _fetch():
+        try:
+            for b in blocks:
+                if stop.is_set():
+                    return
+                q.put(("ok", ray_tpu.get(b, timeout=300)))
+        except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
+            q.put(("err", e))
+            return
+        q.put(("end", None))
+
+    t = threading.Thread(target=_fetch, daemon=True)
+    t.start()
+    try:
+        while True:
+            kind, val = q.get()
+            if kind == "end":
+                return
+            if kind == "err":
+                raise val
+            yield val
+    finally:
+        # abandoned generator: unblock a fetcher stuck on q.put
+        stop.set()
+        try:
+            q.get_nowait()
+        except Exception:
+            pass
+
+
 def _to_batch(block: list, batch_format: str):
     return block_to_batch(block, batch_format)
 
@@ -197,8 +257,34 @@ def _from_batch(batch) -> list:
 
 
 class Dataset:
-    def __init__(self, blocks: List[ObjectRef]):
-        self._blocks = blocks
+    """Blocks + a small lazy op chain.
+
+    map/filter/map_batches APPEND to the chain instead of spawning tasks;
+    the first access to ``_blocks`` (any action: iteration, counts,
+    shuffle, write, ...) fuses the whole chain into ONE task per block
+    (reference: data/_internal/plan.py — lazy stages with fusion; this
+    keeps the reference's eager-feeling API, materializing on action)."""
+
+    def __init__(self, blocks: List[ObjectRef], _ops: Optional[List[tuple]] = None):
+        self._raw_blocks = blocks
+        self._ops: List[tuple] = list(_ops or [])
+        self._fused: Optional[List[ObjectRef]] = None
+
+    @property
+    def _blocks(self) -> List[ObjectRef]:
+        if not self._ops:
+            return self._raw_blocks
+        if self._fused is None:
+            self._fused = [
+                _apply_fused.remote(self._ops, b) for b in self._raw_blocks
+            ]
+        return self._fused
+
+    def _with_op(self, op: tuple) -> "Dataset":
+        if self._fused is not None:
+            # already materialized: start a fresh chain on those blocks
+            return Dataset(self._fused, _ops=[op])
+        return Dataset(self._raw_blocks, _ops=self._ops + [op])
 
     # ------------------------------------------------------------ creation
 
@@ -234,7 +320,7 @@ class Dataset:
     # ---------------------------------------------------------- transforms
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return Dataset([_map_block.remote(fn, b) for b in self._blocks])
+        return self._with_op(("map", fn))
 
     def map_batches(
         self,
@@ -244,11 +330,13 @@ class Dataset:
         compute: Optional["ActorPoolStrategy"] = None,
     ) -> "Dataset":
         if compute is not None:
+            # actor-pool compute is its own execution strategy: materialize
+            # any pending chain first (via ._blocks), then fan out
             return compute._map_batches(self, fn, batch_format)
-        return Dataset([_map_batch.remote(fn, b, batch_format) for b in self._blocks])
+        return self._with_op(("map_batches", fn, batch_format))
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return Dataset([_filter_block.remote(fn, b) for b in self._blocks])
+        return self._with_op(("filter", fn))
 
     def _block_counts(self) -> List[int]:
         return ray_tpu.get(
@@ -434,10 +522,25 @@ class Dataset:
         for b in self._blocks:
             yield from block_rows(ray_tpu.get(b, timeout=300))
 
-    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy") -> Iterator[Any]:
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_blocks: int = 2,
+    ) -> Iterator[Any]:
+        """Batched iteration with block prefetch: a fetcher thread stays
+        ``prefetch_blocks`` ahead of consumption, so Train-style consumers
+        never stall on a block boundary (reference: iterator
+        prefetch_blocks, data/dataset.py iter_batches)."""
+        blocks = self._blocks
+        if prefetch_blocks <= 0 or len(blocks) <= 1:
+            fetched = (ray_tpu.get(b, timeout=300) for b in blocks)
+        else:
+            fetched = _prefetch_iter(blocks, prefetch_blocks)
         buf: List[Any] = []
-        for b in self._blocks:
-            buf.extend(block_rows(ray_tpu.get(b, timeout=300)))
+        for block in fetched:
+            buf.extend(block_rows(block))
             while len(buf) >= batch_size:
                 yield _to_batch(buf[:batch_size], batch_format)
                 buf = buf[batch_size:]
@@ -477,14 +580,17 @@ class Dataset:
         return write_tfrecords(self, dir_path)
 
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        # block count is invariant under the fused op chain: answer from
+        # the raw blocks so inspection never triggers execution
+        return len(self._raw_blocks)
 
     def schema(self):
         first = self.take(1)
         return type(first[0]).__name__ if first else None
 
     def __repr__(self):
-        return f"Dataset(num_blocks={len(self._blocks)})"
+        lazy = f", pending_ops={len(self._ops)}" if self._ops and self._fused is None else ""
+        return f"Dataset(num_blocks={len(self._raw_blocks)}{lazy})"
 
 
 class GroupedDataset:
