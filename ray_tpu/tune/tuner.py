@@ -4,6 +4,13 @@ Analog of the reference (reference: python/ray/tune/tuner.py:40 Tuner →
 tune/execution/trial_runner.py:236 TrialRunner.step loop →
 ray_trial_executor.py:200 actor-per-trial placement).  Trials are actors;
 their report streams drive the scheduler's continue/stop decisions.
+
+Durability scope: experiment state persists to a DRIVER-LOCAL directory
+(Tuner.restore resumes after a driver-process crash/restart on the same
+host).  There is no cloud/URI sync — a lost driver HOST loses the
+experiment (the reference's tune/syncer.py remote-storage upload is the
+missing analog; plug external storage by pointing RunConfig.storage_path
+at a mounted share).
 """
 
 from __future__ import annotations
