@@ -29,14 +29,28 @@ class WorkerKiller:
         self._thread: Optional[threading.Thread] = None
 
     def _worker_pids(self) -> List[int]:
-        from ray_tpu.experimental.state import list_actors
-
+        import os
         import subprocess
 
-        out = subprocess.run(
-            ["pgrep", "-f", "ray_tpu.core.worker_main"], capture_output=True, text=True
-        )
-        return [int(p) for p in out.stdout.split()]
+        pids: List[int] = []
+        # exec'd workers keep the worker_main cmdline; zygote-FORKED
+        # workers inherit the zygote's cmdline, so match both and tell the
+        # zygote SERVER (stdin = the spawner's pipe) apart from its forked
+        # workers (stdin redirected to /dev/null)
+        for pattern in ("ray_tpu.core.worker_main", "ray_tpu._private.zygote"):
+            out = subprocess.run(
+                ["pgrep", "-f", pattern], capture_output=True, text=True
+            )
+            for p in out.stdout.split():
+                pid = int(p)
+                if pattern.endswith("zygote"):
+                    try:
+                        if os.readlink(f"/proc/{pid}/fd/0") != os.devnull:
+                            continue  # the zygote server itself
+                    except OSError:
+                        continue
+                pids.append(pid)
+        return pids
 
     def _loop(self):
         import os

@@ -169,6 +169,7 @@ class CoreWorker:
         self._actor_events_subscribed = False
         self._push_task_handler: Optional[Callable[[dict], None]] = None
         self._early_pushes: List[dict] = []  # frames that raced handler setup
+        self._disconnect_cbs: List[Callable[[], None]] = []
         self._subscriptions: Dict[str, List[Callable[[dict], None]]] = {}
         self.connected = False
 
@@ -221,6 +222,25 @@ class CoreWorker:
                     self._push_task_handler({"cancel": payload.get("task_id")})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             self.connected = False
+            for cb in list(self._disconnect_cbs):
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    def on_disconnect(self, cb: Callable[[], None]):
+        """Invoke cb (io thread) when the head connection drops — a worker
+        whose head died must EXIT, not linger as an orphan blocked on its
+        task queue (reference analog: workers die with their raylet).
+        If the connection already dropped (head died before this
+        registration), cb fires immediately — the callback must tolerate
+        a possible double invocation in that race."""
+        self._disconnect_cbs.append(cb)
+        if not self.connected:
+            try:
+                cb()
+            except Exception:
+                pass
 
     async def _heartbeat_loop(self):
         period = RayConfig.heartbeat_period_ms / 1000.0

@@ -417,7 +417,13 @@ def main():
     worker_mod.global_worker.core_worker = cw
     worker_mod.global_worker.mode = "worker"
 
+    # a worker whose head died must exit, not linger as an orphan blocked
+    # on its task queue (reference: workers die with their raylet); the
+    # sentinel unblocks run(), and hard-exit below skips joining actor
+    # executor threads that may be wedged in user code
+    cw.on_disconnect(lambda: runtime.task_queue.put(None))
     runtime.run()
+    os._exit(0)
 
 
 if __name__ == "__main__":

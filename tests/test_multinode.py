@@ -120,6 +120,50 @@ def test_cross_node_object_transfer(cluster):
     assert float(big[-1]) == 2_999_999.0
 
 
+def test_workers_exit_when_head_dies():
+    """A worker whose head is SIGKILLed must EXIT, not linger as an
+    orphan blocked on its task queue (r5 regression: zygote-forked AND
+    exec'd workers both leaked after hard head death; reference
+    semantics: workers die with their raylet)."""
+    import os
+    import signal
+    import time
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def pid():
+            return os.getpid()
+
+        worker_pids = {ray_tpu.get(pid.remote(), timeout=120) for _ in range(3)}
+        from ray_tpu._private.worker import global_worker
+
+        head = global_worker.head_proc
+        assert head is not None
+        os.kill(head.pid, signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            alive = [p for p in worker_pids if os.path.exists(f"/proc/{p}")]
+            # zombies count as exited: check state
+            really = []
+            for p in alive:
+                try:
+                    with open(f"/proc/{p}/stat") as f:
+                        if f.read().split()[2] != "Z":
+                            really.append(p)
+                except OSError:
+                    pass
+            if not really:
+                break
+            time.sleep(0.5)
+        assert not really, f"workers survived head death: {really}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
 def test_hung_node_declared_dead_by_heartbeat_timeout(monkeypatch):
     """A SIGSTOPped raylet keeps its TCP socket open, so death must come
     from missed heartbeats, not disconnect (reference analog:
