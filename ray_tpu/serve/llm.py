@@ -244,9 +244,9 @@ class ShardedLLM:
 
 
 def llm_deployment(
-    model: str = "llama_3b",
+    model="llama_3b",
     *,
-    max_seq_len: int = 256,
+    max_seq_len: Optional[int] = None,
     new_tokens: int = 32,
     max_batch_size: int = 8,
     batch_wait_timeout_s: float = 0.02,
@@ -256,7 +256,10 @@ def llm_deployment(
 ):
     """Build a Serve deployment wrapping a ShardedLLM replica.
 
-    The replica claims ``num_tpus`` chips and shards over every device jax
+    ``model`` is a LlamaConfig constructor name ("llama_3b", "llama2_7b",
+    ...) or a LlamaConfig INSTANCE (resolved worker-side either way —
+    pass an instance for configs the name registry doesn't have).  The
+    replica claims ``num_tpus`` chips and shards over every device jax
     exposes inside the actor (tp defaults to all of them) — the same code
     path serves llama_3b on one chip and llama2_7b on a mesh."""
     from ray_tpu import serve
@@ -274,12 +277,23 @@ def llm_deployment(
     )
     class LLMDeployment:
         def __init__(self):
+            import dataclasses
+
             import jax
             import jax.numpy as jnp
 
-            cfg = getattr(LlamaConfig, model)(
-                max_seq_len=max_seq_len, param_dtype=jnp.bfloat16
-            )
+            if isinstance(model, LlamaConfig):
+                # an explicit max_seq_len overrides; otherwise the
+                # instance's own value stands
+                cfg = (
+                    model
+                    if max_seq_len is None
+                    else dataclasses.replace(model, max_seq_len=max_seq_len)
+                )
+            else:
+                cfg = getattr(LlamaConfig, model)(
+                    max_seq_len=max_seq_len or 256, param_dtype=jnp.bfloat16
+                )
             self.engine = ShardedLLM(cfg, tp=tp)
             self.platform = jax.devices()[0].platform
 

@@ -113,36 +113,29 @@ def test_sharded_llm_rejects_bad_tp():
 
 def test_llm_deployment_through_serve(ray_cluster):
     """The llm_deployment factory serves generation through the real
-    Serve path (handle → replica → ShardedLLM engine)."""
+    Serve path (handle → replica → ShardedLLM engine).  A config
+    INSTANCE is passed (it must resolve worker-side — a driver-side
+    monkeypatched constructor name would not exist in the replica's
+    process)."""
     import jax.numpy as jnp
 
     from ray_tpu.models.llama import LlamaConfig
     from ray_tpu.serve import llm as llm_mod
 
-    # patch a tiny config in as a classmethod-style ctor
-    orig = getattr(LlamaConfig, "tiny_serve", None)
-    LlamaConfig.tiny_serve = classmethod(
-        lambda cls, **kw: cls(
-            dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
-            vocab_size=256, **kw,
-        )
+    cfg = LlamaConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        vocab_size=256, compute_dtype=jnp.float32,
     )
-    try:
-        dep = llm_mod.llm_deployment(
-            "tiny_serve", max_seq_len=32, new_tokens=4, max_batch_size=4,
-            num_tpus=0, tp=1,
-        )
-        handle = serve.run(dep.bind())
-        refs = [handle.remote(i) for i in range(3)]
-        results = ray_tpu.get(refs, timeout=300)
-        assert all(len(seq) == 4 for seq in results)
-        info = ray_tpu.get(
-            serve.get_deployment_handle("llm").method("info").remote(), timeout=60
-        )
-        assert info["tp"] == 1
-        assert info["shards"]["total_bytes"] > 0
-    finally:
-        if orig is None:
-            del LlamaConfig.tiny_serve
-        else:
-            LlamaConfig.tiny_serve = orig
+    dep = llm_mod.llm_deployment(
+        cfg, max_seq_len=32, new_tokens=4, max_batch_size=4,
+        num_tpus=0, tp=1,
+    )
+    handle = serve.run(dep.bind())
+    refs = [handle.remote(i) for i in range(3)]
+    results = ray_tpu.get(refs, timeout=300)
+    assert all(len(seq) == 4 for seq in results)
+    info = ray_tpu.get(
+        serve.get_deployment_handle("llm").method("info").remote(), timeout=60
+    )
+    assert info["tp"] == 1
+    assert info["shards"]["total_bytes"] > 0
