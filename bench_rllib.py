@@ -97,26 +97,107 @@ def main():
         # each loaded-batch call consumes B fresh env steps
         learner_steps_per_sec = n_up * B / learner_dt
 
-        print(
-            json.dumps(
+        # device-resident variant: the SAME staged batch re-used, so the
+        # number isolates the jitted update from the H2D transfer (which
+        # rides the axon tunnel here and dominates the loaded-batch form)
+        t0 = time.time()
+        for _ in range(n_up):
+            algo.policy.learn_on_loaded_batch(staged, algo.config.num_sgd_iter, 800)
+        resident_steps_per_sec = n_up * B / (time.time() - t0)
+
+        sac = _bench_sac()
+
+        result = (
                 {
                     "metric": "ppo_pixel_cnn_env_steps_per_sec_per_chip",
                     "value": round(env_steps_per_sec, 1),
                     "unit": "env_steps/s/chip",
+                    # the reference publishes NO absolute env-steps/s for
+                    # this config (BASELINE.json published: {}): 1.0 here
+                    # means "the required capability exists and learns",
+                    # not a measured speedup over a reference number
                     "vs_baseline": 1.0,
+                    "vs_baseline_basis": "existence (reference publishes no absolute number)",
                     "platform": platform,
                     "path": "rollout_actors+tpu_learner",
                     "learner_only_env_steps_per_sec": round(learner_steps_per_sec, 1),
+                    "learner_device_resident_env_steps_per_sec": round(
+                        resident_steps_per_sec, 1
+                    ),
                     "num_rollout_workers": num_workers,
                     "num_envs_per_worker": num_envs,
                     "obs_shape": [84, 84, 4],
                     "episode_reward_mean": round(reward, 3),
+                    "sac_pendulum": sac,
                 }
-            )
         )
+        with open("RLBENCH_r05.json", "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
         algo.stop()
     finally:
         ray_tpu.shutdown()
+
+
+def _bench_sac():
+    """Continuous-control throughput: SAC on the vectorized Pendulum —
+    acting + replay + jitted twin-Q/actor/alpha updates, end to end
+    (VERDICT r4 #3's env-steps/s evidence)."""
+    from ray_tpu.rllib.env import PendulumEnv
+    from ray_tpu.rllib.replay_buffer import ReplayBuffer
+    from ray_tpu.rllib.sac import SACPolicy
+    from ray_tpu.rllib.sample_batch import (
+        ACTIONS,
+        DONES,
+        NEXT_OBS,
+        OBS,
+        REWARDS,
+        SampleBatch,
+    )
+
+    env = PendulumEnv(num_envs=16, seed=0)
+    pol = SACPolicy(
+        obs_shape=(3,), act_dim=1,
+        action_low=env.action_space.low, action_high=env.action_space.high,
+        hidden=(128, 128), seed=0,
+    )
+    buf = ReplayBuffer(100_000, seed=0)
+    obs = env.reset(seed=0)
+    ep_rew = np.zeros(16)
+    ep_hist = []
+    # warmup fills the buffer + compiles act/update
+    rng = np.random.default_rng(0)
+    for _ in range(80):
+        raw = rng.uniform(-1, 1, (16, 1)).astype(np.float32)
+        nobs, rew, done, _ = env.step(pol._center + pol._scale * raw)
+        buf.add(SampleBatch({OBS: obs, ACTIONS: raw, REWARDS: rew,
+                             NEXT_OBS: nobs, DONES: done.astype(np.float32)}))
+        obs = nobs
+    pol.learn_on_batch(buf.sample(128))
+    t0 = time.time()
+    env_steps = 0
+    iters = 500
+    for _ in range(iters):
+        env_a, raw = pol.compute_actions(obs)
+        nobs, rew, done, _ = env.step(env_a)
+        buf.add(SampleBatch({OBS: obs, ACTIONS: raw, REWARDS: rew,
+                             NEXT_OBS: nobs, DONES: done.astype(np.float32)}))
+        env_steps += 16
+        ep_rew += rew
+        for i in np.nonzero(done)[0]:
+            ep_hist.append(ep_rew[i])
+            ep_rew[i] = 0.0
+        obs = nobs
+        for _ in range(4):
+            metrics = pol.learn_on_batch(buf.sample(128))
+    dt = time.time() - t0
+    return {
+        "env_steps_per_sec": round(env_steps / dt, 1),
+        "grad_updates_per_sec": round(iters * 4 / dt, 1),
+        "updates_per_env_step": 0.25,
+        "episode_reward_mean": round(float(np.mean(ep_hist[-10:])) if ep_hist else 0.0, 1),
+        "alpha": round(metrics["alpha"], 4),
+    }
 
 
 if __name__ == "__main__":

@@ -3,15 +3,15 @@ TPU behind a @serve.batch deployment — tokens/s + request p50/p99 at
 several offered loads, autoscaling engaged.
 
 Product path: client → DeploymentHandle → TPU-claiming replica actor →
-ONE jitted lax.scan generating all requested tokens per coalesced batch
-(per-token host dispatch would be tunnel-RPC-bound; the scan keeps the
-whole generation on-chip).  Model: a llama-family config sized for one
-16G v5e chip in bf16 (llama2_7b bf16 weights alone are ~13.5 GB — the
-7B-at-scale story is the multi-chip mesh in the dryrun; serving THIS
-chip honestly means ~3B).  Reference analog:
+the tp-sharded ShardedLLM engine (ray_tpu/serve/llm.py, tp=1 on this
+one-chip host; the SAME code path the multi-chip dryrun proves at
+llama2_7b shape) — ONE jitted prefill+decode program per coalesced
+batch with the KV cache donated.  Model: a llama-family config sized
+for one 16G v5e chip in bf16 (llama2_7b bf16 weights alone are
+~13.5 GB — 7B serving is the tp mesh story).  Reference analog:
 python/ray/serve/benchmarks + serve/batching.py:46.
 
-Writes SERVE_BENCH_r04.json and prints one JSON line.
+Writes SERVE_BENCH_r05.json and prints one JSON line.
 """
 
 import json
@@ -22,10 +22,7 @@ import numpy as np
 
 MAX_SEQ = 256
 NEW_TOKENS = 32
-# B=8 is the measured sweet spot on one 16G v5e: the in-place cache path
-# decodes at 18.6ms/step (429 tok/s raw); B=16's 2x2.6GB cache + 6.7GB
-# weights crosses the HBM aliasing cliff and REGRESSES to 84ms/step
-MAX_BATCH = 8
+MAX_BATCH = int(os.environ.get("SERVE_BENCH_MAX_BATCH", "8"))
 MODEL = os.environ.get("SERVE_BENCH_MODEL", "llama_3b")
 
 
@@ -35,13 +32,17 @@ def main():
     jax.config.update("jax_platforms", "cpu")  # driver never claims the chip
     import ray_tpu
     from ray_tpu import serve
+    from ray_tpu.serve.llm import llm_deployment
 
     ray_tpu.init(num_cpus=6, num_tpus=1)
 
-    @serve.deployment(
-        name="llm",
-        ray_actor_options={"num_tpus": 1},
-        max_concurrent_queries=64,
+    dep = llm_deployment(
+        MODEL,
+        max_seq_len=MAX_SEQ,
+        new_tokens=NEW_TOKENS,
+        max_batch_size=MAX_BATCH,
+        batch_wait_timeout_s=0.02,
+        num_tpus=1,
         autoscaling_config={
             # engaged: scales on in-flight load, pinned to the one chip
             "min_replicas": 1,
@@ -49,67 +50,10 @@ def main():
             "target_num_ongoing_requests_per_replica": 32,
         },
     )
-    class LlamaService:
-        def __init__(self):
-            import jax
-            import jax.numpy as jnp
-
-            from ray_tpu.models.llama import LlamaConfig, LlamaModel
-
-            cfg = getattr(LlamaConfig, MODEL)(
-                max_seq_len=MAX_SEQ,
-                param_dtype=jnp.bfloat16,  # serving: weights live bf16
-            )
-            self.cfg = cfg
-            self.model = LlamaModel(cfg)
-            self.params = self.model.init(jax.random.PRNGKey(0))
-            self.platform = jax.devices()[0].platform
-
-            def generate(params, tokens0, n_new):
-                B = tokens0.shape[0]
-                cache = self.model.init_cache(B)
-
-                def body(carry, t):
-                    tok, cache = carry
-                    logits, cache = self.model.decode_step(params, cache, tok, t)
-                    nxt = jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
-                    return (nxt, cache), nxt[:, 0]
-
-                (_, _), toks = jax.lax.scan(
-                    body, (tokens0, cache), jnp.arange(n_new)
-                )
-                return toks.T  # [B, n_new]
-
-            import functools
-
-            self._generate = jax.jit(functools.partial(generate, n_new=NEW_TOKENS))
-
-        @serve.batch(max_batch_size=MAX_BATCH, batch_wait_timeout_s=0.02)
-        async def generate(self, prompts):
-            import jax.numpy as jnp
-
-            B = len(prompts)
-            # pad to the ONE compiled batch shape: a ragged batch per
-            # coalesce would retrace/recompile per distinct size
-            ids = [int(p) % self.cfg.vocab_size for p in prompts]
-            ids += [0] * (MAX_BATCH - B)
-            tokens0 = jnp.asarray([[i] for i in ids], jnp.int32)
-            out = np.asarray(self._generate(self.params, tokens0))
-            return [out[b].tolist() for b in range(B)]
-
-        async def __call__(self, prompt):
-            return await self.generate(prompt)
-
-        def info(self):
-            return {
-                "platform": self.platform,
-                "params_b": round(self.cfg.num_params() / 1e9, 2),
-            }
-
-    handle = serve.run(LlamaService.bind())
+    handle = serve.run(dep.bind())
     # warmup: compile the generation program
     t0 = time.time()
-    ray_tpu.get(handle.remote(1), timeout=1200)
+    ray_tpu.get(handle.remote(1), timeout=1800)
     compile_s = time.time() - t0
     info = ray_tpu.get(
         serve.get_deployment_handle("llm").method("info").remote(), timeout=60
@@ -152,16 +96,18 @@ def main():
         "value": max(r["tokens_per_sec"] for r in rows),
         "unit": "tokens/s/chip",
         "vs_baseline": 1.0,
+        "vs_baseline_basis": "existence (reference publishes no absolute number)",
         "model": MODEL,
         "params_b": info["params_b"],
         "platform": info["platform"],
+        "engine": "ShardedLLM tp=%d (donated-cache prefill+decode)" % info["tp"],
         "new_tokens_per_request": NEW_TOKENS,
         "batching": {"max_batch_size": MAX_BATCH, "batch_wait_timeout_s": 0.02},
         "autoscaling_engaged": True,
         "compile_s": round(compile_s, 1),
         "loads": rows,
     }
-    with open("SERVE_BENCH_r04.json", "w") as f:
+    with open("SERVE_BENCH_r05.json", "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     serve.shutdown()

@@ -1,0 +1,110 @@
+"""Round-5 decode-cliff experiment: why does llama_3b decode regress from
+18.6ms/step at B=8 to 84ms/step at B=16 on one 16G v5e?
+
+Hypothesis (VERDICT r4 weak #1): the lax.scan carry double-buffers the
+KV cache (2 x ~1.3GB at B=16) because nothing tells XLA it may alias the
+carry in place.  Variants:
+
+  scan        — r4 shipped path: one jit, cache created inside, lax.scan
+  scan_donate — cache created OUTSIDE, passed as a donated jit arg
+  step_donate — per-token jitted decode_step with donate_argnums on the
+                cache; host loop chains device-resident tokens (no sync
+                per token, dispatch pipelines over the tunnel)
+
+Usage: python scripts/profile_decode_r5.py [batch ...]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from ray_tpu.models.llama import LlamaConfig, LlamaModel  # noqa: E402
+
+MAX_SEQ = 256
+NEW = 32
+
+
+def bench(fn, *args, iters=3):
+    # force a host transfer of the result each iteration — the axon
+    # tunnel's block_until_ready can return before compute finishes
+    import numpy as _np
+
+    _np.asarray(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.time()
+        _np.asarray(fn(*args))
+        times.append(time.time() - t0)
+    return min(times)
+
+
+def main():
+    batches = [int(a) for a in sys.argv[1:]] or [8, 16]
+    cfg = LlamaConfig.llama_3b(max_seq_len=MAX_SEQ, param_dtype=jnp.bfloat16)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    params = jax.device_put(params)
+    jax.block_until_ready(params)
+    print(f"params: {cfg.num_params()/1e9:.2f}B")
+
+    for B in batches:
+        tokens0 = jnp.zeros((B, 1), jnp.int32)
+
+        # -------- variant 1: r4 scan (cache inside jit)
+        def generate_scan(params, tokens0):
+            cache = model.init_cache(B)
+
+            def body(carry, t):
+                tok, cache = carry
+                logits, cache = model.decode_step(params, cache, tok, t)
+                nxt = jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+                return (nxt, cache), nxt[:, 0]
+
+            (_, _), toks = jax.lax.scan(body, (tokens0, cache), jnp.arange(NEW))
+            return toks.T
+
+        f = jax.jit(generate_scan)
+        dt = bench(f, params, tokens0)
+        print(f"B={B} scan        : {dt*1000/NEW:7.2f} ms/step  {B*NEW/dt:8.1f} tok/s")
+
+        # -------- variant 2: scan with donated external cache
+        def generate_scan_d(params, cache, tokens0):
+            def body(carry, t):
+                tok, cache = carry
+                logits, cache = model.decode_step(params, cache, tok, t)
+                nxt = jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+                return (nxt, cache), nxt[:, 0]
+
+            (_, _), toks = jax.lax.scan(body, (tokens0, cache), jnp.arange(NEW))
+            return toks.T
+
+        f2 = jax.jit(generate_scan_d, donate_argnums=(1,))
+        def run2(params, tokens0):
+            cache = jax.jit(lambda: model.init_cache(B))()
+            return f2(params, cache, tokens0)
+        dt = bench(run2, params, tokens0)
+        print(f"B={B} scan_donate : {dt*1000/NEW:7.2f} ms/step  {B*NEW/dt:8.1f} tok/s")
+
+        # -------- variant 3: per-token jitted step, donated cache
+        step = jax.jit(model.decode_step, donate_argnums=(1,))
+
+        def run3(params, tokens0):
+            cache = jax.jit(lambda: model.init_cache(B))()
+            tok = tokens0
+            outs = []
+            for t in range(NEW):
+                logits, cache = step(params, cache, tok, jnp.int32(t))
+                tok = jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+                outs.append(tok)
+            return jnp.concatenate(outs, axis=1)
+
+        dt = bench(run3, params, tokens0)
+        print(f"B={B} step_donate : {dt*1000/NEW:7.2f} ms/step  {B*NEW/dt:8.1f} tok/s")
+
+
+if __name__ == "__main__":
+    main()
