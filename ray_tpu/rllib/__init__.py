@@ -10,6 +10,13 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_vector_env,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy, SACWorker  # noqa: F401
+from ray_tpu.rllib.td3 import (  # noqa: F401
+    DDPG,
+    DDPGConfig,
+    TD3,
+    TD3Config,
+    TD3Policy,
+)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.multi_agent import (  # noqa: F401
     MultiAgentEnv,

@@ -239,16 +239,22 @@ class SACPolicy:
 
 
 class SACWorker:
-    """Rollout actor: stochastic-policy stepping over a VectorEnv,
-    storing RAW (-1,1) actions so the learner's log-probs line up."""
+    """Rollout actor for the off-policy continuous-control family:
+    policy-driven stepping over a VectorEnv, storing RAW (-1,1) actions
+    so the learner's log-probs/critics line up.  ``policy_cls``
+    parameterizes the family — SAC by default, TD3/DDPG reuse the same
+    sampling loop (truncation-aware bootstrapping included) with their
+    own policy."""
 
-    def __init__(self, env_creator, policy_config, seed=0, num_envs: int = 1):
+    def __init__(
+        self, env_creator, policy_config, seed=0, num_envs: int = 1, policy_cls=None
+    ):
         from ray_tpu.rllib.env import make_vector_env
 
         self.env = make_vector_env(env_creator, num_envs, seed=seed)
         self.num_envs = self.env.num_envs
         space = self.env.action_space
-        self.policy = SACPolicy(
+        self.policy = (policy_cls or SACPolicy)(
             obs_shape=tuple(self.env.observation_space.shape),
             act_dim=int(np.prod(space.shape)),
             action_low=space.low,
@@ -341,17 +347,16 @@ class SACConfig(AlgorithmConfig):
 class SAC(Algorithm):
     """Replay-driven training loop (reference: sac.py training_step):
     rollout workers push transitions; the driver-side jitted learner
-    takes num_train_per_iter gradient steps per iteration."""
+    takes num_train_per_iter gradient steps per iteration.
 
-    def __init__(self, config: SACConfig):
-        super().__init__(config)
-        env = config.env_creator()
-        obs_shape = tuple(env.observation_space.shape)
-        space = env.action_space
-        act_dim = int(np.prod(space.shape))
-        low, high = space.low, space.high
-        del env
-        policy_config = {
+    The loop is the whole off-policy continuous-control family's:
+    subclasses (TD3/DDPG) override POLICY_CLS / _worker_factory /
+    _policy_config and inherit train()/stop() unchanged."""
+
+    POLICY_CLS = SACPolicy
+
+    def _policy_config(self, config) -> Dict[str, Any]:
+        return {
             "actor_lr": config.actor_lr,
             "critic_lr": config.critic_lr,
             "alpha_lr": config.alpha_lr,
@@ -360,7 +365,21 @@ class SAC(Algorithm):
             "hidden": tuple(config.hidden),
             "target_entropy": config.target_entropy,
         }
-        self.policy = SACPolicy(
+
+    def _worker_factory(self):
+        """Returns (worker_class, extra ctor kwargs)."""
+        return SACWorker, {}
+
+    def __init__(self, config):
+        super().__init__(config)
+        env = config.env_creator()
+        obs_shape = tuple(env.observation_space.shape)
+        space = env.action_space
+        act_dim = int(np.prod(space.shape))
+        low, high = space.low, space.high
+        del env
+        policy_config = self._policy_config(config)
+        self.policy = self.POLICY_CLS(
             obs_shape=obs_shape,
             act_dim=act_dim,
             action_low=low,
@@ -368,13 +387,15 @@ class SAC(Algorithm):
             seed=config.seed,
             **policy_config,
         )
-        worker_cls = ray_tpu.remote(SACWorker)
+        worker_body, worker_kwargs = self._worker_factory()
+        worker_cls = ray_tpu.remote(worker_body)
         self.workers = [
             worker_cls.remote(
                 config.env_creator,
                 policy_config,
                 seed=config.seed + i,
                 num_envs=config.num_envs_per_worker,
+                **worker_kwargs,
             )
             for i in range(config.num_rollout_workers)
         ]

@@ -1,0 +1,278 @@
+"""TD3 (+DDPG as its special case): deterministic continuous control.
+
+Analog of the reference's TD3/DDPG family (reference:
+rllib/algorithms/td3/td3.py — DDPG with twin Q, delayed policy updates
+and target policy smoothing; rllib/algorithms/ddpg/ddpg_torch_policy.py).
+Shares the replay/rollout machinery with SAC; the whole update (twin-Q
+TD step, optional delayed actor step, fused polyak of BOTH target nets)
+is ONE jitted program — the delay is a traced modulo on the update
+counter, so there is no per-step recompile.
+
+DDPG = TD3Config(policy_delay=1, twin_q=False, smoothing_sigma=0).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.models import mlp_init
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+from ray_tpu.rllib.sac import SAC, _mlp_apply
+
+
+class TD3Policy:
+    """Deterministic tanh actor + twin Q critics, delayed actor updates,
+    target policy smoothing — one jitted update."""
+
+    def __init__(
+        self,
+        obs_shape,
+        act_dim: int,
+        action_low: Optional[np.ndarray] = None,
+        action_high: Optional[np.ndarray] = None,
+        actor_lr: float = 1e-3,
+        critic_lr: float = 1e-3,
+        gamma: float = 0.99,
+        tau: float = 0.005,
+        hidden=(256, 256),
+        policy_delay: int = 2,
+        smoothing_sigma: float = 0.2,
+        smoothing_clip: float = 0.5,
+        twin_q: bool = True,
+        exploration_sigma: float = 0.1,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dim = int(np.prod(obs_shape))
+        self.act_dim = int(act_dim)
+        self.gamma = gamma
+        self.tau = tau
+        self.policy_delay = max(1, int(policy_delay))
+        self.exploration_sigma = exploration_sigma
+        low = np.full(act_dim, -1.0) if action_low is None else np.asarray(action_low)
+        high = np.full(act_dim, 1.0) if action_high is None else np.asarray(action_high)
+        self._scale = ((high - low) / 2.0).astype(np.float32)
+        self._center = ((high + low) / 2.0).astype(np.float32)
+
+        rng = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(rng, 3)
+        pi_sizes = (self.obs_dim, *hidden, act_dim)
+        q_sizes = (self.obs_dim + act_dim, *hidden, 1)
+        self.actor_params = mlp_init(ka, pi_sizes)
+        self.q_params = {"q1": mlp_init(k1, q_sizes), "q2": mlp_init(k2, q_sizes)}
+        self.actor_target = jax.tree.map(lambda x: x, self.actor_params)
+        self.q_target = jax.tree.map(lambda x: x, self.q_params)
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.q_params)
+        self.update_count = 0
+        self._rng = np.random.default_rng(seed + 1)
+
+        gamma_, tau_ = self.gamma, self.tau
+        delay = self.policy_delay
+
+        def pi(params, obs):
+            return jnp.tanh(_mlp_apply(params, obs))
+
+        def q_all(qp, obs, act):
+            x = jnp.concatenate([obs, act], axis=-1)
+            q1 = _mlp_apply(qp["q1"], x)[..., 0]
+            if twin_q:
+                return q1, _mlp_apply(qp["q2"], x)[..., 0]
+            return q1, q1
+
+        @jax.jit
+        def _act(params, obs):
+            return pi(params, obs)
+
+        @jax.jit
+        def _update(
+            actor_params, q_params, actor_target, q_target,
+            actor_os, critic_os, step,
+            key, obs, act, rew, next_obs, done,
+        ):
+            import optax as _optax
+
+            # --- critics: TD target with smoothed target-policy action
+            def critic_loss(qp):
+                a2 = pi(actor_target, next_obs)
+                noise = jnp.clip(
+                    smoothing_sigma * jax.random.normal(key, a2.shape),
+                    -smoothing_clip,
+                    smoothing_clip,
+                )
+                a2 = jnp.clip(a2 + noise, -1.0, 1.0)
+                t1, t2 = q_all(q_target, next_obs, a2)
+                backup = rew + gamma_ * (1.0 - done) * jnp.minimum(t1, t2)
+                backup = jax.lax.stop_gradient(backup)
+                q1, q2 = q_all(qp, obs, act)
+                loss = ((q1 - backup) ** 2).mean()
+                if twin_q:
+                    loss = loss + ((q2 - backup) ** 2).mean()
+                return loss, q1.mean()
+
+            (closs, q1m), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(q_params)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os)
+            q_params = _optax.apply_updates(q_params, cupd)
+
+            # --- delayed actor + target updates: traced modulo, masked
+            # apply — no recompile across steps, DDPG when delay == 1
+            def actor_loss(ap):
+                q1, _ = q_all(q_params, obs, pi(ap, obs))
+                return -q1.mean()
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(actor_params)
+            aupd, actor_os_new = self.actor_opt.update(agrads, actor_os)
+            actor_new = _optax.apply_updates(actor_params, aupd)
+            do_actor = (step % delay) == 0
+
+            def sel(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(do_actor, n, o), new, old
+                )
+
+            actor_params = sel(actor_new, actor_params)
+            actor_os = sel(actor_os_new, actor_os)
+            # BOTH target nets update only on the delayed steps (Fujimoto
+            # TD3 / reference td3.py — gating just the actor target would
+            # double the critic target's effective tau at delay=2)
+            actor_target = sel(
+                jax.tree.map(
+                    lambda t, o: (1.0 - tau_) * t + tau_ * o, actor_target, actor_params
+                ),
+                actor_target,
+            )
+            q_target = sel(
+                jax.tree.map(
+                    lambda t, o: (1.0 - tau_) * t + tau_ * o, q_target, q_params
+                ),
+                q_target,
+            )
+            metrics = {"critic_loss": closs, "actor_loss": aloss, "q1_mean": q1m}
+            return (
+                actor_params, q_params, actor_target, q_target,
+                actor_os, critic_os, metrics,
+            )
+
+        self._act_fn = _act
+        self._update_fn = _update
+        self._jax = jax
+
+    def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
+        raw = np.asarray(self._act_fn(self.actor_params, np.asarray(obs, np.float32)))
+        if not deterministic and self.exploration_sigma > 0:
+            raw = np.clip(
+                raw + self._rng.normal(0.0, self.exploration_sigma, raw.shape), -1, 1
+            ).astype(np.float32)
+        return self._center + self._scale * raw, raw
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax
+
+        key = jax.random.PRNGKey(self.update_count)
+        (
+            self.actor_params, self.q_params, self.actor_target, self.q_target,
+            self.actor_opt_state, self.critic_opt_state, metrics,
+        ) = self._update_fn(
+            self.actor_params, self.q_params, self.actor_target, self.q_target,
+            self.actor_opt_state, self.critic_opt_state,
+            np.int32(self.update_count),
+            key,
+            np.asarray(batch[OBS], np.float32),
+            np.asarray(batch[ACTIONS], np.float32),
+            np.asarray(batch[REWARDS], np.float32),
+            np.asarray(batch[NEXT_OBS], np.float32),
+            np.asarray(batch[DONES], np.float32),
+        )
+        self.update_count += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.actor_params)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.actor_params = jax.tree.map(jnp.asarray, weights)
+
+
+@dataclass
+class TD3Config(AlgorithmConfig):
+    buffer_size: int = 100_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 256
+    num_train_per_iter: int = 64
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    tau: float = 0.005
+    hidden: tuple = (256, 256)
+    policy_delay: int = 2
+    smoothing_sigma: float = 0.2
+    smoothing_clip: float = 0.5
+    twin_q: bool = True
+    exploration_sigma: float = 0.1
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+@dataclass
+class DDPGConfig(TD3Config):
+    """DDPG is TD3 without its three tricks (reference:
+    rllib/algorithms/ddpg)."""
+
+    policy_delay: int = 1
+    smoothing_sigma: float = 0.0
+    twin_q: bool = False
+
+    def build(self) -> "DDPG":
+        return DDPG(self)
+
+
+class TD3(SAC):
+    """SAC's replay-driven loop with the TD3 policy/worker pair —
+    train()/stop() inherited unchanged."""
+
+    POLICY_CLS = TD3Policy
+
+    def _policy_config(self, config) -> Dict[str, Any]:
+        return {
+            "actor_lr": config.actor_lr,
+            "critic_lr": config.critic_lr,
+            "gamma": config.gamma,
+            "tau": config.tau,
+            "hidden": tuple(config.hidden),
+            "policy_delay": config.policy_delay,
+            "smoothing_sigma": config.smoothing_sigma,
+            "smoothing_clip": config.smoothing_clip,
+            "twin_q": config.twin_q,
+            "exploration_sigma": config.exploration_sigma,
+        }
+
+    def _worker_factory(self):
+        from ray_tpu.rllib.td3_worker import TD3Worker
+
+        return TD3Worker, {}
+
+
+class DDPG(TD3):
+    pass
