@@ -7,6 +7,7 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
 )
 from ray_tpu.tune.search import (  # noqa: F401
     ConcurrencyLimiter,
+    GPSearcher,
     Searcher,
     TPESearcher,
     choice,

@@ -81,7 +81,14 @@ def grid_search(values) -> GridSearch:
 class Searcher:
     """Iterative suggestion protocol (reference: tune/search/searcher.py
     Searcher — suggest per trial, learn from completed results; the shape
-    hyperopt/optuna integrations plug into)."""
+    hyperopt/optuna integrations plug into).
+
+    Space-sampling helpers live here so every model-based searcher draws
+    and classifies domains identically (subclasses provide ``self.space``
+    and ``self.rng``)."""
+
+    space: Dict[str, Any]
+    rng: random.Random
 
     def set_search_properties(self, metric: str, mode: str, param_space: Dict[str, Any]):
         raise NotImplementedError
@@ -91,6 +98,24 @@ class Searcher:
 
     def on_trial_complete(self, trial_id: str, metrics: Dict[str, Any]):
         pass
+
+    def _random_config(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _numeric_keys(self) -> List[str]:
+        return [
+            k
+            for k, v in self.space.items()
+            if isinstance(v, (Uniform, LogUniform, Randint))
+        ]
 
 
 class TPESearcher(Searcher):
@@ -112,24 +137,6 @@ class TPESearcher(Searcher):
 
     def set_search_properties(self, metric, mode, param_space):
         self.metric, self.mode, self.space = metric, mode, dict(param_space)
-
-    def _random_config(self) -> Dict[str, Any]:
-        cfg = {}
-        for k, v in self.space.items():
-            if isinstance(v, GridSearch):
-                cfg[k] = self.rng.choice(v.values)
-            elif isinstance(v, Domain):
-                cfg[k] = v.sample(self.rng)
-            else:
-                cfg[k] = v
-        return cfg
-
-    def _numeric_keys(self) -> List[str]:
-        return [
-            k
-            for k, v in self.space.items()
-            if isinstance(v, (Uniform, LogUniform, Randint))
-        ]
 
     def _density(self, cfg, group) -> float:
         """Log-density of cfg under the group's configs: per-dim Gaussian
@@ -182,6 +189,106 @@ class TPESearcher(Searcher):
         if self.metric in metrics:
             # remember the config actually run (numeric keys only needed)
             self._results.append((float(metrics[self.metric]), dict(metrics.get("config") or {})))
+
+
+class GPSearcher(Searcher):
+    """Native Gaussian-process EI searcher (reference analog:
+    tune/search/bayesopt/bayesopt_search.py, whose backend is a GP with
+    expected improvement; no external deps — an exact GP on the trial
+    history, which at tune scale (tens to a few hundred trials) is a
+    small dense solve).
+
+    Numeric dims normalize to [0,1] (log-space for LogUniform); Choice
+    dims are sampled uniformly (the GP models the numeric subspace).
+    """
+
+    def __init__(
+        self,
+        n_startup: int = 8,
+        n_candidates: int = 256,
+        length_scale: float = 0.25,
+        noise: float = 1e-3,
+        xi: float = 0.01,
+        seed: int = 0,
+    ):
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self.rng = random.Random(seed)
+        self.metric = "loss"
+        self.mode = "min"
+        self.space: Dict[str, Any] = {}
+        self._results: List[tuple] = []  # (score, config)
+
+    def set_search_properties(self, metric, mode, param_space):
+        self.metric, self.mode, self.space = metric, mode, dict(param_space)
+
+    def _bounds(self, k):
+        import math
+
+        dom = self.space[k]
+        if isinstance(dom, LogUniform):
+            return dom.lo, dom.hi, (lambda v: math.log(max(float(v), 1e-300)))
+        if isinstance(dom, Uniform):
+            return float(dom.low), float(dom.high), float
+        return float(dom.low), float(dom.high), float  # Randint
+
+    def _normalize(self, cfg) -> List[float]:
+        out = []
+        for k in self._numeric_keys():
+            lo, hi, xf = self._bounds(k)
+            span = max(hi - lo, 1e-12)
+            out.append((xf(cfg[k]) - lo) / span)
+        return out
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        keys = self._numeric_keys()
+        usable = [
+            (s, c) for s, c in self._results if all(k in c for k in keys)
+        ]
+        if len(usable) < self.n_startup or not keys:
+            return self._random_config()
+        import math
+
+        import numpy as np
+
+        X = np.array([self._normalize(c) for _, c in usable])
+        y = np.array([s for s, _ in usable], dtype=float)
+        if self.mode == "max":
+            y = -y  # internal convention: minimize
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        y = (y - y_mean) / y_std
+        # RBF gram + EI over random candidates
+        l2 = 2.0 * self.length_scale**2
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / l2) + self.noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        except np.linalg.LinAlgError:
+            return self._random_config()
+        cands = [self._random_config() for _ in range(self.n_candidates)]
+        Xc = np.array([self._normalize(c) for c in cands])
+        kx = np.exp(-(((Xc[:, None, :] - X[None, :, :]) ** 2).sum(-1)) / l2)
+        mu = kx @ alpha
+        v = np.linalg.solve(L, kx.T)
+        var = np.maximum(1.0 - (v**2).sum(0), 1e-12)
+        sigma = np.sqrt(var)
+        best = y.min()
+        z = (best - mu - self.xi) / sigma
+        # standard-normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = (best - mu - self.xi) * cdf + sigma * pdf
+        return cands[int(np.argmax(ei))]
+
+    def on_trial_complete(self, trial_id: str, metrics: Dict[str, Any]):
+        if self.metric in metrics:
+            self._results.append(
+                (float(metrics[self.metric]), dict(metrics.get("config") or {}))
+            )
 
 
 class ConcurrencyLimiter(Searcher):

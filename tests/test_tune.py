@@ -237,6 +237,12 @@ def test_tpe_beats_random_on_noisy_objective():
     rnd_best = run_search(RandomSearcher(seed=7))
     assert tpe_best < rnd_best, (tpe_best, rnd_best)
 
+    # the native GP-EI searcher must beat random at equal budget too
+    from ray_tpu.tune.search import GPSearcher
+
+    gp_best = run_search(GPSearcher(n_startup=10, seed=7))
+    assert gp_best < rnd_best, (gp_best, rnd_best)
+
 
 def test_concurrency_limiter_caps_inflight_suggestions():
     from ray_tpu import tune
