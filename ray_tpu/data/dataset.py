@@ -64,11 +64,25 @@ def _apply_fused(ops, block):
             block = [op[1](row) for row in block_rows(block)]
         elif kind == "filter":
             block = [row for row in block_rows(block) if op[1](row)]
+        elif kind == "flat_map":
+            block = [y for row in block_rows(block) for y in op[1](row)]
         elif kind == "map_batches":
             block = batch_to_block(op[1](block_to_batch(block, op[2])))
         else:
             raise ValueError(f"unknown fused op {kind!r}")
     return block
+
+
+@ray_tpu.remote
+def _numeric_agg_block(block, column):
+    """Per-block numeric partials: (count, sum, min, max)."""
+    vals = [
+        float(row[column]) if column is not None else float(row)
+        for row in block_rows(block)
+    ]
+    if not vals:
+        return (0, 0.0, None, None)
+    return (len(vals), sum(vals), min(vals), max(vals))
 
 
 @ray_tpu.remote
@@ -337,6 +351,77 @@ class Dataset:
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
         return self._with_op(("filter", fn))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        """Row → rows (reference: Dataset.flat_map); fuses into the lazy
+        chain like map/filter."""
+        return self._with_op(("flat_map", fn))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets block-wise (reference: Dataset.union) —
+        no data movement, just the combined block lists."""
+        blocks = list(self._blocks)
+        for o in others:
+            blocks.extend(o._blocks)
+        return Dataset(blocks)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (reference: Dataset.limit) — incremental: blocks
+        materialize (and any pending fused chain executes) FRONT-TO-BACK
+        only until the cumulative count reaches n, so a tiny limit on a
+        huge mapped dataset touches only the prefix it needs.  Counts
+        travel to the driver; rows are sliced in tasks."""
+        n = max(0, int(n))
+        if n == 0:
+            return Dataset([ray_tpu.put([])])
+        ops = self._ops if self._fused is None else []
+        src = self._raw_blocks if ops else self._blocks
+        picked: List[ObjectRef] = []
+        counts: List[int] = []
+        total = 0
+        for raw in src:
+            blk = _apply_fused.remote(ops, raw) if ops else raw
+            c = int(ray_tpu.get(_block_count.remote(blk), timeout=300))
+            picked.append(blk)
+            counts.append(c)
+            total += c
+            if total >= n:
+                break
+        plan = []
+        remaining = n
+        for bi, c in enumerate(counts):
+            take = min(c, remaining)
+            if take > 0:
+                plan.append((bi, 0, take))
+                remaining -= take
+        return Dataset([_slice_concat.remote(plan, *picked)])
+
+    # -------------------------------------------------------- aggregates
+
+    def _numeric_agg(self, column: Optional[str]):
+        parts = ray_tpu.get(
+            [_numeric_agg_block.remote(b, column) for b in self._blocks],
+            timeout=600,
+        )
+        count = sum(p[0] for p in parts)
+        total = sum(p[1] for p in parts)
+        mins = [p[2] for p in parts if p[2] is not None]
+        maxs = [p[3] for p in parts if p[3] is not None]
+        return count, total, (min(mins) if mins else None), (max(maxs) if maxs else None)
+
+    def sum(self, column: Optional[str] = None) -> float:
+        """Distributed numeric sum over rows (or a dict column)."""
+        return self._numeric_agg(column)[1]
+
+    def min(self, column: Optional[str] = None):
+        return self._numeric_agg(column)[2]
+
+    def max(self, column: Optional[str] = None):
+        return self._numeric_agg(column)[3]
+
+    def mean(self, column: Optional[str] = None) -> Optional[float]:
+        count, total, _, _ = self._numeric_agg(column)
+        return total / count if count else None
 
     def _block_counts(self) -> List[int]:
         return ray_tpu.get(

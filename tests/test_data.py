@@ -96,6 +96,29 @@ def test_fused_dataset_reusable_after_materialization(ray_cluster):
     assert sorted(ds.take_all()) == [x * 3 for x in range(10)]
 
 
+def test_flat_map_union_limit_aggregates(ray_cluster):
+    """Breadth parity: flat_map (fused), union, limit, numeric
+    aggregates (reference: Dataset.{flat_map,union,limit,sum,mean})."""
+    ds = rdata.range(10, parallelism=2)
+    doubled = ds.flat_map(lambda x: [x, x])
+    assert sorted(doubled.take_all()) == sorted(list(range(10)) * 2)
+
+    u = rdata.range(5).union(rdata.range(5).map(lambda x: x + 5))
+    assert sorted(u.take_all()) == list(range(10))
+
+    lim = rdata.range(100, parallelism=8).limit(7)
+    assert lim.take_all() == [0, 1, 2, 3, 4, 5, 6]
+    assert rdata.range(3).limit(50).count() == 3
+
+    nums = rdata.range(10, parallelism=3)
+    assert nums.sum() == 45
+    assert nums.min() == 0 and nums.max() == 9
+    assert nums.mean() == 4.5
+    rows = rdata.from_items([{"v": 2.0}, {"v": 4.0}], parallelism=2)
+    assert rows.sum("v") == 6.0
+    assert rows.mean("v") == 3.0
+
+
 def test_iter_batches_prefetches_ahead(ray_cluster):
     """The fetcher thread stays ahead: total wall time for consuming B
     slow-to-produce blocks overlaps consumption with fetching, and every
