@@ -279,18 +279,35 @@ class Dataset:
     (reference: data/_internal/plan.py — lazy stages with fusion; this
     keeps the reference's eager-feeling API, materializing on action)."""
 
-    def __init__(self, blocks: List[ObjectRef], _ops: Optional[List[tuple]] = None):
-        self._raw_blocks = blocks
-        self._ops: List[tuple] = list(_ops or [])
+    def __init__(
+        self,
+        blocks: Optional[List[ObjectRef]] = None,
+        _ops: Optional[List[tuple]] = None,
+        _parts: Optional[List[tuple]] = None,
+    ):
+        # internal form: (raw block, pending op chain) pairs — per-PART
+        # chains let union() stay lazy across operands with different
+        # pending transforms
+        if _parts is not None:
+            self._parts = _parts
+        else:
+            ops = tuple(_ops or ())
+            self._parts = [(b, ops) for b in (blocks or [])]
         self._fused: Optional[List[ObjectRef]] = None
+        self._agg_cache: Dict[Optional[str], tuple] = {}
+
+    @property
+    def _raw_blocks(self) -> List[ObjectRef]:
+        return [b for b, _ in self._parts]
 
     @property
     def _blocks(self) -> List[ObjectRef]:
-        if not self._ops:
-            return self._raw_blocks
+        if all(not ops for _, ops in self._parts):
+            return [b for b, _ in self._parts]
         if self._fused is None:
             self._fused = [
-                _apply_fused.remote(self._ops, b) for b in self._raw_blocks
+                _apply_fused.remote(list(ops), b) if ops else b
+                for b, ops in self._parts
             ]
         return self._fused
 
@@ -298,7 +315,7 @@ class Dataset:
         if self._fused is not None:
             # already materialized: start a fresh chain on those blocks
             return Dataset(self._fused, _ops=[op])
-        return Dataset(self._raw_blocks, _ops=self._ops + [op])
+        return Dataset(_parts=[(b, ops + (op,)) for b, ops in self._parts])
 
     # ------------------------------------------------------------ creation
 
@@ -359,11 +376,20 @@ class Dataset:
 
     def union(self, *others: "Dataset") -> "Dataset":
         """Concatenate datasets block-wise (reference: Dataset.union) —
-        no data movement, just the combined block lists."""
-        blocks = list(self._blocks)
+        no data movement and LAZY: each operand's pending fused chain
+        rides along unexecuted (per-part op chains), so e.g.
+        a.map(f).union(b).limit(5) still only runs f over the prefix
+        limit needs."""
+
+        def parts_of(ds: "Dataset"):
+            if ds._fused is not None:
+                return [(b, ()) for b in ds._fused]
+            return list(ds._parts)
+
+        parts = parts_of(self)
         for o in others:
-            blocks.extend(o._blocks)
-        return Dataset(blocks)
+            parts.extend(parts_of(o))
+        return Dataset(_parts=parts)
 
     def limit(self, n: int) -> "Dataset":
         """First n rows (reference: Dataset.limit) — incremental: blocks
@@ -374,31 +400,35 @@ class Dataset:
         n = max(0, int(n))
         if n == 0:
             return Dataset([ray_tpu.put([])])
-        ops = self._ops if self._fused is None else []
-        src = self._raw_blocks if ops else self._blocks
-        picked: List[ObjectRef] = []
-        counts: List[int] = []
+        parts = (
+            [(b, ()) for b in self._fused] if self._fused is not None else self._parts
+        )
+        out: List[ObjectRef] = []
         total = 0
-        for raw in src:
-            blk = _apply_fused.remote(ops, raw) if ops else raw
+        for raw, ops in parts:
+            blk = _apply_fused.remote(list(ops), raw) if ops else raw
             c = int(ray_tpu.get(_block_count.remote(blk), timeout=300))
-            picked.append(blk)
-            counts.append(c)
+            if total + c <= n:
+                # full block rides by REFERENCE: block structure (and so
+                # downstream parallelism) is preserved — only a block
+                # straddling the cut gets sliced
+                if c > 0:
+                    out.append(blk)
+            else:
+                out.append(_slice_concat.remote([(0, 0, n - total)], blk))
             total += c
             if total >= n:
                 break
-        plan = []
-        remaining = n
-        for bi, c in enumerate(counts):
-            take = min(c, remaining)
-            if take > 0:
-                plan.append((bi, 0, take))
-                remaining -= take
-        return Dataset([_slice_concat.remote(plan, *picked)])
+        return Dataset(out if out else [ray_tpu.put([])])
 
     # -------------------------------------------------------- aggregates
 
     def _numeric_agg(self, column: Optional[str]):
+        # memoized: sum()/min()/max()/mean() on one (immutable) Dataset
+        # share a single distributed partials pass
+        cached = self._agg_cache.get(column)
+        if cached is not None:
+            return cached
         parts = ray_tpu.get(
             [_numeric_agg_block.remote(b, column) for b in self._blocks],
             timeout=600,
@@ -407,7 +437,11 @@ class Dataset:
         total = sum(p[1] for p in parts)
         mins = [p[2] for p in parts if p[2] is not None]
         maxs = [p[3] for p in parts if p[3] is not None]
-        return count, total, (min(mins) if mins else None), (max(maxs) if maxs else None)
+        result = (
+            count, total, (min(mins) if mins else None), (max(maxs) if maxs else None)
+        )
+        self._agg_cache[column] = result
+        return result
 
     def sum(self, column: Optional[str] = None) -> float:
         """Distributed numeric sum over rows (or a dict column)."""
@@ -666,16 +700,17 @@ class Dataset:
 
     def num_blocks(self) -> int:
         # block count is invariant under the fused op chain: answer from
-        # the raw blocks so inspection never triggers execution
-        return len(self._raw_blocks)
+        # the parts so inspection never triggers execution
+        return len(self._parts)
 
     def schema(self):
         first = self.take(1)
         return type(first[0]).__name__ if first else None
 
     def __repr__(self):
-        lazy = f", pending_ops={len(self._ops)}" if self._ops and self._fused is None else ""
-        return f"Dataset(num_blocks={len(self._raw_blocks)}{lazy})"
+        pending = max((len(ops) for _, ops in self._parts), default=0)
+        lazy = f", pending_ops={pending}" if pending and self._fused is None else ""
+        return f"Dataset(num_blocks={len(self._parts)}{lazy})"
 
 
 class GroupedDataset:

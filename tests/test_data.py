@@ -106,9 +106,19 @@ def test_flat_map_union_limit_aggregates(ray_cluster):
     u = rdata.range(5).union(rdata.range(5).map(lambda x: x + 5))
     assert sorted(u.take_all()) == list(range(10))
 
+    # union is LAZY: operands' pending chains ride along unexecuted
+    before = _finished_tasks()
+    lazy_u = rdata.range(8, parallelism=2).map(lambda x: x * 10).union(
+        rdata.range(4, parallelism=2)
+    )
+    assert _finished_tasks() == before  # nothing ran yet
+    assert sorted(lazy_u.take_all()) == sorted([x * 10 for x in range(8)] + [0, 1, 2, 3])
+
     lim = rdata.range(100, parallelism=8).limit(7)
     assert lim.take_all() == [0, 1, 2, 3, 4, 5, 6]
     assert rdata.range(3).limit(50).count() == 3
+    # limit preserves block structure for the fully-taken prefix
+    assert rdata.range(100, parallelism=10).limit(25).num_blocks() == 3
 
     nums = rdata.range(10, parallelism=3)
     assert nums.sum() == 45
