@@ -1,0 +1,2 @@
+from ray_tpu.util.client.client import ClientAPI, ClientObjectRef, connect  # noqa: F401
+from ray_tpu.util.client.server import ClientServer  # noqa: F401

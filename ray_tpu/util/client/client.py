@@ -1,0 +1,292 @@
+"""Thin Ray client: talks ONLY the client protocol — no head
+connection, no store mmap, no driver bootstrap (reference:
+python/ray/util/client/ — the client worker proxying to the server,
+which acts as the driver).
+
+    api = connect("127.0.0.1:10001")
+    double = api.remote(lambda x: x * 2)
+    ref = double.remote(21)
+    api.get(ref)  # 42
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.protocol import MAX_FRAME, MsgType, pack, unpack
+from ray_tpu.util.client.proto import CHUNK, CMsg
+
+_LEN = struct.Struct("<I")
+
+
+class ClientObjectRef:
+    __slots__ = ("id", "_api")
+
+    def __init__(self, ref_id: int, api: "ClientAPI"):
+        self.id = ref_id
+        self._api = api
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id})"
+
+
+def _mark_refs(obj):
+    """ClientObjectRef → wire marker (plain containers only; the server
+    swaps markers back for its real ObjectRefs)."""
+    if isinstance(obj, ClientObjectRef):
+        return {"__client_ref__": obj.id}
+    if isinstance(obj, dict):
+        return {k: _mark_refs(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_mark_refs(v) for v in obj]
+        return type(obj)(out) if isinstance(obj, tuple) else out
+    return obj
+
+
+class _RemoteCallable:
+    def __init__(self, api: "ClientAPI", fn_id: bytes, options: Optional[dict] = None):
+        self._api = api
+        self._fn_id = fn_id
+        self._options = options
+
+    def options(self, **kw) -> "_RemoteCallable":
+        return _RemoteCallable(self._api, self._fn_id, kw)
+
+    def remote(self, *args, **kwargs):
+        reply = self._api._call(
+            CMsg.C_SCHEDULE,
+            {
+                "fn_id": self._fn_id,
+                "args": self._api._pack_args(args, kwargs),
+                "options": self._options,
+            },
+        )
+        refs = [ClientObjectRef(i, self._api) for i in reply["ref_ids"]]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class _ActorMethod:
+    def __init__(self, api, actor_id, name):
+        self._api, self._actor_id, self._name = api, actor_id, name
+
+    def remote(self, *args, **kwargs):
+        reply = self._api._call(
+            CMsg.C_ACTOR_CALL,
+            {
+                "actor_id": self._actor_id,
+                "method": self._name,
+                "args": self._api._pack_args(args, kwargs),
+            },
+        )
+        return ClientObjectRef(reply["ref_ids"][0], self._api)
+
+
+class ClientActorHandle:
+    def __init__(self, api: "ClientAPI", actor_id: int):
+        self._api = api
+        self._actor_id = actor_id
+
+    def __getattr__(self, name):
+        return _ActorMethod(self._api, self._actor_id, name)
+
+
+class _RemoteActorClass:
+    def __init__(self, api: "ClientAPI", fn_id: bytes, options: Optional[dict] = None):
+        self._api = api
+        self._fn_id = fn_id
+        self._options = options
+
+    def options(self, **kw) -> "_RemoteActorClass":
+        return _RemoteActorClass(self._api, self._fn_id, kw)
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        reply = self._api._call(
+            CMsg.C_CREATE_ACTOR,
+            {
+                "fn_id": self._fn_id,
+                "args": self._api._pack_args(args, kwargs),
+                "options": self._options,
+            },
+        )
+        return ClientActorHandle(self._api, reply["actor_id"])
+
+
+class ClientAPI:
+    """Synchronous thin-client session."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._pending: Dict[int, dict] = {}
+        self._data: Dict[int, dict] = {}
+        self._cv = threading.Condition()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self._call(CMsg.C_HELLO, {})
+
+    # ------------------------------------------------------------- plumbing
+
+    def _read_loop(self):
+        buf = b""
+        try:
+            while True:
+                while len(buf) < _LEN.size:
+                    chunk = self._sock.recv(1 << 16)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    buf += chunk
+                (n,) = _LEN.unpack(buf[: _LEN.size])
+                if n > MAX_FRAME:
+                    raise ConnectionError(f"frame too large: {n}")
+                while len(buf) < _LEN.size + n:
+                    chunk = self._sock.recv(1 << 20)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    buf += chunk
+                body = buf[_LEN.size : _LEN.size + n]
+                buf = buf[_LEN.size + n :]
+                msg_type, rid, payload = unpack(body)
+                with self._cv:
+                    if msg_type == CMsg.C_DATA:
+                        t = self._data.setdefault(
+                            payload["tid"], {"chunks": [], "done": False, "error": None}
+                        )
+                        t["chunks"].append(bytes(payload["data"]))
+                        t["error"] = payload.get("error")
+                        if payload.get("last"):
+                            t["done"] = True
+                    else:
+                        self._pending[rid] = {"type": msg_type, "payload": payload}
+                    self._cv.notify_all()
+        except (ConnectionError, OSError):
+            with self._cv:
+                self._pending[-1] = {
+                    "type": int(MsgType.ERROR_REPLY),
+                    "payload": {"error": "connection lost"},
+                }
+                self._cv.notify_all()
+
+    def _send(self, msg_type: int, payload: dict, rid: int):
+        frame = pack(msg_type, rid, payload)
+        with self._lock:
+            self._sock.sendall(frame)
+
+    def _call(
+        self, msg_type: int, payload: dict, timeout: Optional[float] = 600.0
+    ) -> dict:
+        """timeout=None waits indefinitely (ray get/wait semantics)."""
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+        self._send(msg_type, payload, rid)
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: rid in self._pending or -1 in self._pending, timeout
+            )
+            if not ok:
+                raise TimeoutError(f"client call {msg_type} timed out")
+            if rid not in self._pending and -1 in self._pending:
+                raise ConnectionError("client-server connection lost")
+            reply = self._pending.pop(rid)
+        if reply["type"] == int(MsgType.ERROR_REPLY):
+            raise RuntimeError(reply["payload"].get("error", "client server error"))
+        return reply["payload"]
+
+    def _pack_args(self, args, kwargs) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps((_mark_refs(list(args)), _mark_refs(kwargs)))
+
+    # ------------------------------------------------------------------ api
+
+    def remote(self, fn_or_class):
+        import cloudpickle
+        import inspect
+
+        blob = cloudpickle.dumps(fn_or_class)
+        fn_id = self._call(CMsg.C_PUT_FUNCTION, {"blob": blob})["fn_id"]
+        if inspect.isclass(fn_or_class):
+            return _RemoteActorClass(self, bytes(fn_id))
+        return _RemoteCallable(self, bytes(fn_id))
+
+    def put(self, value: Any) -> ClientObjectRef:
+        blob = pickle.dumps(value, protocol=5)
+        tid = self._call(CMsg.C_PUT_BEGIN, {})["tid"]
+        for i in range(0, max(len(blob), 1), CHUNK):
+            self._call(CMsg.C_PUT_CHUNK, {"tid": tid, "data": blob[i : i + CHUNK]})
+        reply = self._call(CMsg.C_PUT_END, {"tid": tid})
+        return ClientObjectRef(reply["ref_id"], self)
+
+    def get(self, ref, timeout: Optional[float] = 600.0):
+        """timeout=None waits indefinitely (ray semantics)."""
+        if isinstance(ref, list):
+            return [self.get(r, timeout) for r in ref]
+        with self._lock:
+            self._rid += 1
+            tid = 1_000_000_000 + self._rid
+        ctrl_timeout = None if timeout is None else timeout + 30.0
+        try:
+            self._call(
+                CMsg.C_GET,
+                {"ref_id": ref.id, "tid": tid, "timeout": timeout},
+                timeout=ctrl_timeout,
+            )
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: self._data.get(tid, {}).get("done") or -1 in self._pending,
+                    ctrl_timeout,
+                )
+                if not ok:
+                    raise TimeoutError("get() data channel timed out")
+        finally:
+            with self._cv:
+                # always claim the transfer: late chunks must not
+                # accumulate after a timeout/error
+                t = self._data.pop(tid, None)
+        if t is None or not t["done"]:
+            # a truncated stream (server died mid-transfer) is a
+            # connection loss, NOT a complete value
+            raise ConnectionError("client-server connection lost mid-get")
+        value = pickle.loads(b"".join(t["chunks"]))
+        if t["error"] is not None:
+            raise value  # server shipped the exception
+        return value
+
+    def wait(self, refs: List[ClientObjectRef], num_returns: int = 1, timeout=None):
+        reply = self._call(
+            CMsg.C_WAIT,
+            {
+                "ref_ids": [r.id for r in refs],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+            timeout=None if timeout is None else timeout + 30.0,
+        )
+        ready_ids = set(reply["ready_ids"])
+        ready = [r for r in refs if r.id in ready_ids]
+        rest = [r for r in refs if r.id not in ready_ids]
+        return ready, rest
+
+    def kill(self, actor: ClientActorHandle):
+        self._call(CMsg.C_KILL, {"actor_id": actor._actor_id})
+
+    def release(self, refs: List[ClientObjectRef]):
+        self._call(CMsg.C_RELEASE, {"ref_ids": [r.id for r in refs]})
+
+    def disconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: str, timeout: float = 30.0) -> ClientAPI:
+    """Connect a thin client to a running ClientServer ("host:port")."""
+    host, port = address.rsplit(":", 1)
+    return ClientAPI(host, int(port), timeout)

@@ -1,0 +1,33 @@
+"""Ray-Client wire protocol: the thin-client ↔ client-server taxonomy.
+
+Analog of the reference's ray_client.proto (reference:
+python/ray/util/client/ARCHITECTURE.md — a narrow RPC surface plus a
+streaming DATA channel).  Frames ride the same length-prefixed msgpack
+Connection as the control plane; large payloads stream as C_DATA chunk
+pushes so neither side buffers a whole object per frame."""
+
+from __future__ import annotations
+
+import enum
+
+CHUNK = 1 << 20  # 1 MiB data-channel chunks
+
+
+class CMsg(enum.IntEnum):
+    # session
+    C_HELLO = 100
+    # data channel (client -> server puts stream BEGIN/CHUNK frames;
+    # server -> client gets stream C_DATA pushes tagged by transfer id)
+    C_PUT_BEGIN = 101
+    C_PUT_CHUNK = 102
+    C_PUT_END = 103
+    C_GET = 104
+    C_DATA = 105
+    # driver surface (server-as-driver executes these with ITS CoreWorker)
+    C_PUT_FUNCTION = 110
+    C_SCHEDULE = 111
+    C_CREATE_ACTOR = 112
+    C_ACTOR_CALL = 113
+    C_WAIT = 114
+    C_KILL = 115
+    C_RELEASE = 116

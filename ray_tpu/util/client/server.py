@@ -1,0 +1,322 @@
+"""Ray-Client server: the SERVER is the driver.
+
+Analog of the reference's client server (reference:
+python/ray/util/client/ARCHITECTURE.md + server/server.py — thin
+clients speak a narrow RPC; a server process co-located with the
+cluster hosts each client's driver state and owns its refs).  Here each
+client connection gets a DriverSession wrapping a full CoreWorker in
+driver mode: function exports, task submission, ownership/refcounting
+and zero-copy store access all happen server-side; the client ships and
+receives payloads over a chunked data channel.
+
+Run standalone:  python -m ray_tpu.util.client.server --head host:port
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict
+
+from ray_tpu._private.protocol import Connection
+from ray_tpu.util.client.proto import CHUNK, CMsg
+
+logger = logging.getLogger(__name__)
+
+
+def _swap_markers(obj, refs: Dict[int, Any]):
+    """Replace client ref markers ({'__client_ref__': id}) with the
+    session's real ObjectRefs in plain containers (the documented
+    contract: refs nested inside custom objects don't resolve)."""
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__client_ref__"}:
+            return refs[obj["__client_ref__"]]
+        return {k: _swap_markers(v, refs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_swap_markers(v, refs) for v in obj]
+        return type(obj)(out) if isinstance(obj, tuple) else out
+    return obj
+
+
+class DriverSession:
+    """One connected client's driver state (server-as-driver)."""
+
+    def __init__(self, server: "ClientServer", conn: Connection):
+        self.server = server
+        self.conn = conn
+        self.refs: Dict[int, Any] = {}  # client ref id -> ObjectRef
+        self.actors: Dict[int, Any] = {}  # client actor id -> ActorHandle
+        self.functions: Dict[bytes, Any] = {}  # sha1 -> RemoteFunction/ActorClass
+        self.next_id = 1
+        self._puts: Dict[int, list] = {}  # in-flight put transfers
+
+    def _new_id(self) -> int:
+        i = self.next_id
+        self.next_id += 1
+        return i
+
+    def _track(self, ref) -> int:
+        cid = self._new_id()
+        self.refs[cid] = ref
+        return cid
+
+    # every handler runs in the server's driver thread pool (the core
+    # worker API is synchronous)
+
+    def put_function(self, p):
+        import hashlib
+
+        import cloudpickle
+
+        import ray_tpu
+
+        blob = bytes(p["blob"])
+        digest = hashlib.sha1(blob).digest()
+        if digest not in self.functions:
+            # wrap ONCE: the RemoteFunction/ActorClass caches its export,
+            # so repeated schedules don't re-cloudpickle the target per
+            # call (a closure capturing a big array would otherwise be
+            # re-serialized on every submission)
+            self.functions[digest] = ray_tpu.remote(cloudpickle.loads(blob))
+        return {"fn_id": digest}
+
+    def _load_args(self, p):
+        import cloudpickle
+
+        args, kwargs = cloudpickle.loads(bytes(p["args"]))
+        args = tuple(_swap_markers(list(args), self.refs))
+        kwargs = {k: _swap_markers(v, self.refs) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def schedule(self, p):
+        rf = self.functions[bytes(p["fn_id"])]
+        args, kwargs = self._load_args(p)
+        opts = p.get("options") or {}
+        if opts:
+            rf = rf.options(**opts)
+        out = rf.remote(*args, **kwargs)
+        refs = out if isinstance(out, list) else [out]
+        return {"ref_ids": [self._track(r) for r in refs]}
+
+    def create_actor(self, p):
+        ac = self.functions[bytes(p["fn_id"])]
+        args, kwargs = self._load_args(p)
+        opts = p.get("options") or {}
+        if opts:
+            ac = ac.options(**opts)
+        handle = ac.remote(*args, **kwargs)
+        aid = self._new_id()
+        self.actors[aid] = handle
+        return {"actor_id": aid}
+
+    def actor_call(self, p):
+        handle = self.actors[p["actor_id"]]
+        args, kwargs = self._load_args(p)
+        ref = getattr(handle, p["method"]).remote(*args, **kwargs)
+        return {"ref_ids": [self._track(ref)]}
+
+    def wait(self, p):
+        import ray_tpu
+
+        id_list = [int(i) for i in p["ref_ids"]]
+        refs = [self.refs[i] for i in id_list]
+        ready, _ = ray_tpu.wait(
+            refs, num_returns=p.get("num_returns", 1), timeout=p.get("timeout")
+        )
+        ready_set = {id(r) for r in ready}
+        return {"ready_ids": [i for i, r in zip(id_list, refs) if id(r) in ready_set]}
+
+    def kill(self, p):
+        import ray_tpu
+
+        handle = self.actors.pop(p["actor_id"], None)
+        if handle is not None:
+            ray_tpu.kill(handle)
+        return {"ok": True}
+
+    def release(self, p):
+        for i in p["ref_ids"]:
+            self.refs.pop(int(i), None)
+        return {"ok": True}
+
+    # ----------------------------------------------------------- data plane
+
+    def put_begin(self, p):
+        tid = self._new_id()
+        self._puts[tid] = []
+        return {"tid": tid}
+
+    def put_chunk(self, p):
+        self._puts[p["tid"]].append(bytes(p["data"]))
+        return {"ok": True}
+
+    def put_end(self, p):
+        import pickle
+
+        import ray_tpu
+
+        blob = b"".join(self._puts.pop(p["tid"]))
+        value = pickle.loads(blob)
+        return {"ref_id": self._track(ray_tpu.put(value))}
+
+    def get(self, p, loop):
+        """Resolve a ref and STREAM the pickled value back as C_DATA
+        pushes tagged with the request's transfer id."""
+        import pickle
+
+        import ray_tpu
+
+        ref = self.refs[p["ref_id"]]
+        try:
+            value = ray_tpu.get(ref, timeout=p.get("timeout"))
+            blob = pickle.dumps(value, protocol=5)
+            err = None
+        except Exception as e:  # noqa: BLE001 — shipped to the client
+            blob = pickle.dumps(e, protocol=5)
+            err = type(e).__name__
+        tid = p["tid"]
+        n = max(1, -(-len(blob) // CHUNK))
+        for i in range(n):
+            chunk = blob[i * CHUNK : (i + 1) * CHUNK]
+            fut = asyncio.run_coroutine_threadsafe(
+                self.conn.send(
+                    CMsg.C_DATA,
+                    {
+                        "tid": tid,
+                        "idx": i,
+                        "data": chunk,
+                        "last": i == n - 1,
+                        "error": err,
+                    },
+                ),
+                loop,
+            )
+            fut.result(60)
+        return None  # reply already streamed
+
+
+class ClientServer:
+    """Accepts thin clients; one DriverSession each.  The server process
+    itself is a normal (store-mapped) driver on the cluster."""
+
+    def __init__(self, head_address: str, host: str = "127.0.0.1", port: int = 0):
+        self.head_address = head_address
+        self.host = host
+        self.port = port
+        self._server = None
+        self._loop = None
+        self._thread = None
+        self._started = threading.Event()
+
+    # sessions share the server's single driver connection to the head
+    # (ray_tpu.init in the server process); their refs/actors are
+    # partitioned per session
+
+    def start(self) -> int:
+        import ray_tpu
+
+        ray_tpu.init(address=self.head_address)
+
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="client-server")
+        self._thread.start()
+        self._started.wait(30)
+        return self.port
+
+    async def _serve(self):
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer)
+        session = DriverSession(self, conn)
+        loop = asyncio.get_running_loop()
+        handlers = {
+            CMsg.C_PUT_FUNCTION: session.put_function,
+            CMsg.C_SCHEDULE: session.schedule,
+            CMsg.C_CREATE_ACTOR: session.create_actor,
+            CMsg.C_ACTOR_CALL: session.actor_call,
+            CMsg.C_WAIT: session.wait,
+            CMsg.C_KILL: session.kill,
+            CMsg.C_RELEASE: session.release,
+            CMsg.C_PUT_BEGIN: session.put_begin,
+            CMsg.C_PUT_CHUNK: session.put_chunk,
+            CMsg.C_PUT_END: session.put_end,
+        }
+        try:
+            while True:
+                msg_type, rid, payload = await conn.read_frame()
+                if msg_type == CMsg.C_HELLO:
+                    await conn.reply(rid, {"ok": True})
+                    continue
+                if msg_type == CMsg.C_GET:
+                    # streamed reply: run blocking get+send off the loop
+                    def _do_get(p=payload, r=rid):
+                        try:
+                            session.get(p, loop)
+                            asyncio.run_coroutine_threadsafe(
+                                conn.reply(r, {"ok": True}), loop
+                            ).result(60)
+                        except Exception as e:  # noqa: BLE001
+                            asyncio.run_coroutine_threadsafe(
+                                conn.reply(r, {}, error=str(e)), loop
+                            ).result(60)
+
+                    loop.run_in_executor(None, _do_get)
+                    continue
+                handler = handlers.get(msg_type)
+                if handler is None:
+                    await conn.reply(rid, {}, error=f"unknown msg {msg_type}")
+                    continue
+
+                def _do(h=handler, p=payload, r=rid):
+                    try:
+                        reply = h(p)
+                        if reply is not None:
+                            asyncio.run_coroutine_threadsafe(
+                                conn.reply(r, reply), loop
+                            ).result(60)
+                    except Exception as e:  # noqa: BLE001
+                        asyncio.run_coroutine_threadsafe(
+                            conn.reply(r, {}, error=f"{type(e).__name__}: {e}"), loop
+                        ).result(60)
+
+                loop.run_in_executor(None, _do)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10001)
+    args = parser.parse_args()
+    server = ClientServer(args.head, args.host, args.port)
+    port = server.start()
+    print(f"CLIENT_SERVER_PORT {port}", flush=True)
+    import time
+
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

