@@ -666,6 +666,44 @@ class Dataset:
         if buf:
             yield _to_batch(buf, batch_format)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        prefetch_blocks: int = 2,
+        dtypes=None,
+        device: str = "cpu",
+    ) -> Iterator[Any]:
+        """iter_batches with torch tensors (reference:
+        Dataset.iter_torch_batches) — numpy batches convert zero-copy
+        where dtypes allow.  ``dtypes`` is a single torch.dtype or (for
+        dict batches) a per-column Dict[str, torch.dtype], like the
+        reference."""
+        import torch
+
+        def _to_torch(arr, dtype):
+            t = torch.as_tensor(np.ascontiguousarray(arr))
+            if dtype is not None or device != "cpu":
+                t = t.to(
+                    device=device if device != "cpu" else None, dtype=dtype
+                )
+            return t
+
+        for batch in self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            prefetch_blocks=prefetch_blocks,
+        ):
+            if isinstance(batch, dict):
+                per_col = dtypes if isinstance(dtypes, dict) else {}
+                default = None if isinstance(dtypes, dict) else dtypes
+                yield {
+                    k: _to_torch(v, per_col.get(k, default))
+                    for k, v in batch.items()
+                }
+            else:
+                yield _to_torch(batch, None if isinstance(dtypes, dict) else dtypes)
+
     def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
         """Streamed execution over windows of blocks (reference:
         data/dataset_pipeline.py via Dataset.window)."""

@@ -11,7 +11,6 @@ which acts as the driver).
 
 from __future__ import annotations
 
-import pickle
 import socket
 import struct
 import threading
@@ -32,6 +31,15 @@ class ClientObjectRef:
 
     def __repr__(self):
         return f"ClientObjectRef({self.id})"
+
+    def __reduce__(self):
+        # surface the contract instead of an opaque cannot-pickle-socket
+        # error from descending into _api
+        raise TypeError(
+            "ClientObjectRef can only be passed in plain lists/tuples/"
+            "dicts of task arguments (nested inside custom objects it "
+            "cannot be resolved server-side)"
+        )
 
 
 def _mark_refs(obj):
@@ -125,6 +133,7 @@ class ClientAPI:
         self._rid = 0
         self._pending: Dict[int, dict] = {}
         self._data: Dict[int, dict] = {}
+        self._dead_tids: set = set()  # abandoned gets: drop late chunks
         self._cv = threading.Condition()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -154,8 +163,15 @@ class ClientAPI:
                 msg_type, rid, payload = unpack(body)
                 with self._cv:
                     if msg_type == CMsg.C_DATA:
+                        tid = payload["tid"]
+                        if tid in self._dead_tids:
+                            # abandoned get (timeout): drop late chunks so
+                            # they can't accumulate for the conn lifetime
+                            if payload.get("last"):
+                                self._dead_tids.discard(tid)
+                            continue
                         t = self._data.setdefault(
-                            payload["tid"], {"chunks": [], "done": False, "error": None}
+                            tid, {"chunks": [], "done": False, "error": None}
                         )
                         t["chunks"].append(bytes(payload["data"]))
                         t["error"] = payload.get("error")
@@ -216,7 +232,11 @@ class ClientAPI:
         return _RemoteCallable(self, bytes(fn_id))
 
     def put(self, value: Any) -> ClientObjectRef:
-        blob = pickle.dumps(value, protocol=5)
+        import cloudpickle
+
+        # cloudpickle: values defined in the client's __main__ must
+        # roundtrip by value (the server has no such module)
+        blob = cloudpickle.dumps(value, protocol=5)
         tid = self._call(CMsg.C_PUT_BEGIN, {})["tid"]
         for i in range(0, max(len(blob), 1), CHUNK):
             self._call(CMsg.C_PUT_CHUNK, {"tid": tid, "data": blob[i : i + CHUNK]})
@@ -246,14 +266,18 @@ class ClientAPI:
                     raise TimeoutError("get() data channel timed out")
         finally:
             with self._cv:
-                # always claim the transfer: late chunks must not
-                # accumulate after a timeout/error
+                # always claim the transfer; if it never completed, mark
+                # the tid dead so late chunks are dropped on arrival
                 t = self._data.pop(tid, None)
+                if t is None or not t["done"]:
+                    self._dead_tids.add(tid)
         if t is None or not t["done"]:
             # a truncated stream (server died mid-transfer) is a
             # connection loss, NOT a complete value
             raise ConnectionError("client-server connection lost mid-get")
-        value = pickle.loads(b"".join(t["chunks"]))
+        import cloudpickle
+
+        value = cloudpickle.loads(b"".join(t["chunks"]))
         if t["error"] is not None:
             raise value  # server shipped the exception
         return value

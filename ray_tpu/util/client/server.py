@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from ray_tpu._private.protocol import Connection
 from ray_tpu.util.client.proto import CHUNK, CMsg
@@ -50,15 +50,20 @@ class DriverSession:
         self.functions: Dict[bytes, Any] = {}  # sha1 -> RemoteFunction/ActorClass
         self.next_id = 1
         self._puts: Dict[int, list] = {}  # in-flight put transfers
+        # handlers run on executor threads: one client's concurrent
+        # requests race on the session tables without this
+        self._lock = threading.Lock()
 
     def _new_id(self) -> int:
-        i = self.next_id
-        self.next_id += 1
-        return i
+        with self._lock:
+            i = self.next_id
+            self.next_id += 1
+            return i
 
     def _track(self, ref) -> int:
         cid = self._new_id()
-        self.refs[cid] = ref
+        with self._lock:
+            self.refs[cid] = ref
         return cid
 
     # every handler runs in the server's driver thread pool (the core
@@ -73,24 +78,31 @@ class DriverSession:
 
         blob = bytes(p["blob"])
         digest = hashlib.sha1(blob).digest()
-        if digest not in self.functions:
+        with self._lock:
+            missing = digest not in self.functions
+        if missing:
             # wrap ONCE: the RemoteFunction/ActorClass caches its export,
             # so repeated schedules don't re-cloudpickle the target per
             # call (a closure capturing a big array would otherwise be
             # re-serialized on every submission)
-            self.functions[digest] = ray_tpu.remote(cloudpickle.loads(blob))
+            wrapped = ray_tpu.remote(cloudpickle.loads(blob))
+            with self._lock:
+                self.functions.setdefault(digest, wrapped)
         return {"fn_id": digest}
 
     def _load_args(self, p):
         import cloudpickle
 
         args, kwargs = cloudpickle.loads(bytes(p["args"]))
-        args = tuple(_swap_markers(list(args), self.refs))
-        kwargs = {k: _swap_markers(v, self.refs) for k, v in kwargs.items()}
+        with self._lock:
+            refs = dict(self.refs)
+        args = tuple(_swap_markers(list(args), refs))
+        kwargs = {k: _swap_markers(v, refs) for k, v in kwargs.items()}
         return args, kwargs
 
     def schedule(self, p):
-        rf = self.functions[bytes(p["fn_id"])]
+        with self._lock:
+            rf = self.functions[bytes(p["fn_id"])]
         args, kwargs = self._load_args(p)
         opts = p.get("options") or {}
         if opts:
@@ -100,18 +112,21 @@ class DriverSession:
         return {"ref_ids": [self._track(r) for r in refs]}
 
     def create_actor(self, p):
-        ac = self.functions[bytes(p["fn_id"])]
+        with self._lock:
+            ac = self.functions[bytes(p["fn_id"])]
         args, kwargs = self._load_args(p)
         opts = p.get("options") or {}
         if opts:
             ac = ac.options(**opts)
         handle = ac.remote(*args, **kwargs)
         aid = self._new_id()
-        self.actors[aid] = handle
+        with self._lock:
+            self.actors[aid] = handle
         return {"actor_id": aid}
 
     def actor_call(self, p):
-        handle = self.actors[p["actor_id"]]
+        with self._lock:
+            handle = self.actors[p["actor_id"]]
         args, kwargs = self._load_args(p)
         ref = getattr(handle, p["method"]).remote(*args, **kwargs)
         return {"ref_ids": [self._track(ref)]}
@@ -120,7 +135,8 @@ class DriverSession:
         import ray_tpu
 
         id_list = [int(i) for i in p["ref_ids"]]
-        refs = [self.refs[i] for i in id_list]
+        with self._lock:
+            refs = [self.refs[i] for i in id_list]
         ready, _ = ray_tpu.wait(
             refs, num_returns=p.get("num_returns", 1), timeout=p.get("timeout")
         )
@@ -130,50 +146,58 @@ class DriverSession:
     def kill(self, p):
         import ray_tpu
 
-        handle = self.actors.pop(p["actor_id"], None)
+        with self._lock:
+            handle = self.actors.pop(p["actor_id"], None)
         if handle is not None:
             ray_tpu.kill(handle)
         return {"ok": True}
 
     def release(self, p):
-        for i in p["ref_ids"]:
-            self.refs.pop(int(i), None)
+        with self._lock:
+            for i in p["ref_ids"]:
+                self.refs.pop(int(i), None)
         return {"ok": True}
 
     # ----------------------------------------------------------- data plane
 
     def put_begin(self, p):
         tid = self._new_id()
-        self._puts[tid] = []
+        with self._lock:
+            self._puts[tid] = []
         return {"tid": tid}
 
     def put_chunk(self, p):
-        self._puts[p["tid"]].append(bytes(p["data"]))
+        with self._lock:
+            self._puts[p["tid"]].append(bytes(p["data"]))
         return {"ok": True}
 
     def put_end(self, p):
-        import pickle
+        import cloudpickle
 
         import ray_tpu
 
-        blob = b"".join(self._puts.pop(p["tid"]))
-        value = pickle.loads(blob)
+        with self._lock:
+            blob = b"".join(self._puts.pop(p["tid"]))
+        # cloudpickle, like args/functions: client-__main__ classes must
+        # roundtrip by value, not by unresolvable module reference
+        value = cloudpickle.loads(blob)
         return {"ref_id": self._track(ray_tpu.put(value))}
 
     def get(self, p, loop):
         """Resolve a ref and STREAM the pickled value back as C_DATA
         pushes tagged with the request's transfer id."""
-        import pickle
+        import cloudpickle
 
         import ray_tpu
 
-        ref = self.refs[p["ref_id"]]
+        with self._lock:
+            ref = self.refs[p["ref_id"]]
         try:
             value = ray_tpu.get(ref, timeout=p.get("timeout"))
-            blob = pickle.dumps(value, protocol=5)
+            blob = cloudpickle.dumps(value, protocol=5)
             err = None
         except Exception as e:  # noqa: BLE001 — shipped to the client
-            blob = pickle.dumps(e, protocol=5)
+            blob = cloudpickle.dumps(e, protocol=5)
             err = type(e).__name__
         tid = p["tid"]
         n = max(1, -(-len(blob) // CHUNK))
@@ -208,6 +232,7 @@ class ClientServer:
         self._loop = None
         self._thread = None
         self._started = threading.Event()
+        self._error: Optional[BaseException] = None
 
     # sessions share the server's single driver connection to the head
     # (ray_tpu.init in the server process); their refs/actors are
@@ -221,12 +246,20 @@ class ClientServer:
         def _run():
             self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
-            self._loop.run_until_complete(self._serve())
+            try:
+                self._loop.run_until_complete(self._serve())
+            except BaseException as e:  # noqa: BLE001 — surfaced by start()
+                self._error = e
+                self._started.set()
+                return
             self._loop.run_forever()
 
         self._thread = threading.Thread(target=_run, daemon=True, name="client-server")
         self._thread.start()
-        self._started.wait(30)
+        if not self._started.wait(30):
+            raise RuntimeError("client server failed to start within 30s")
+        if self._error is not None:
+            raise RuntimeError(f"client server failed to start: {self._error}")
         return self.port
 
     async def _serve(self):

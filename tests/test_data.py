@@ -129,6 +129,17 @@ def test_flat_map_union_limit_aggregates(ray_cluster):
     assert rows.mean("v") == 3.0
 
 
+def test_iter_torch_batches(ray_cluster):
+    import torch
+
+    ds = rdata.from_numpy(np.arange(20, dtype=np.float32))
+    seen = []
+    for b in ds.iter_torch_batches(batch_size=8):
+        assert isinstance(b, torch.Tensor)
+        seen.extend(float(x) for x in b)
+    assert sorted(seen) == [float(i) for i in range(20)]
+
+
 def test_iter_batches_prefetches_ahead(ray_cluster):
     """The fetcher thread stays ahead: total wall time for consuming B
     slow-to-produce blocks overlaps consumption with fetching, and every
