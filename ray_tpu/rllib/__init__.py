@@ -10,6 +10,7 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_vector_env,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy, SACWorker  # noqa: F401
+from ray_tpu.rllib.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.td3 import (  # noqa: F401
     DDPG,
     DDPGConfig,
