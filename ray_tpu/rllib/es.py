@@ -53,22 +53,29 @@ def evaluate_perturbation(
     action_low,
     action_high,
 ) -> float:
-    """One fan-out task: reconstruct the noise from its seed, roll one
-    episode with the perturbed policy, return the episode return."""
+    """One fan-out task: reconstruct the noise from its seed, roll ONE
+    episode per sub-env with the perturbed policy, return the mean
+    FIRST-episode return (auto-reset rewards past a sub-env's first done
+    must not leak into its fitness)."""
+    from ray_tpu.rllib.env import make_vector_env
+
     noise = np.random.default_rng(seed).standard_normal(theta.shape[0])
     th = theta + sign * sigma * noise
-    env = env_creator()
+    env = make_vector_env(env_creator, 1, seed=seed)
     obs = env.reset(seed=seed)
+    n = env.num_envs
     scale = (np.asarray(action_high) - np.asarray(action_low)) / 2.0
     center = (np.asarray(action_high) + np.asarray(action_low)) / 2.0
-    total = 0.0
+    totals = np.zeros(n)
+    finished = np.zeros(n, bool)
     for _ in range(episode_horizon):
         a = _flat_policy_apply(th, np.asarray(obs, np.float64), sizes)
         obs, rew, done, _ = env.step(center + scale * a)
-        total += float(np.sum(rew))
-        if np.all(done):
+        totals += np.where(finished, 0.0, np.asarray(rew, np.float64))
+        finished |= np.asarray(done, bool)
+        if finished.all():
             break
-    return total
+    return float(totals.mean())
 
 
 @dataclass
@@ -91,11 +98,14 @@ class ES(Algorithm):
 
     def __init__(self, config: ESConfig):
         super().__init__(config)
-        env = config.env_creator()
+        from ray_tpu.rllib.env import make_vector_env
+
+        env = make_vector_env(config.env_creator, 1)
         obs_dim = int(np.prod(env.observation_space.shape))
         act_dim = int(np.prod(env.action_space.shape))
         self._low = env.action_space.low
         self._high = env.action_space.high
+        self._envs_per_eval = env.num_envs
         del env
         self.sizes = (obs_dim, *config.hidden, act_dim)
         rng = np.random.default_rng(config.seed)
@@ -109,13 +119,16 @@ class ES(Algorithm):
         t0 = time.time()
         pairs = max(1, cfg.population // 2)
         seeds = [int(s) for s in self._seed_rng.integers(0, 2**31 - 1, pairs)]
+        # theta ships ONCE per iteration (the broadcast pattern PPO uses
+        # for weights), not re-pickled into each of the 2*pairs tasks
+        theta_ref = ray_tpu.put(self.theta)
         refs = []
         for s in seeds:
             for sign in (1.0, -1.0):
                 refs.append(
                     self._eval_task.remote(
                         cfg.env_creator,
-                        self.theta,
+                        theta_ref,
                         s,
                         sign,
                         cfg.sigma,
@@ -126,7 +139,7 @@ class ES(Algorithm):
                     )
                 )
         returns = np.array(ray_tpu.get(refs, timeout=1200)).reshape(pairs, 2)
-        self.total_episodes += 2 * pairs
+        self.total_episodes += 2 * pairs * self._envs_per_eval
 
         # rank normalization (reference: es utils compute_centered_ranks)
         flat = returns.reshape(-1)
