@@ -73,6 +73,29 @@ def _apply_fused(ops, block):
     return block
 
 
+def _compact_plan(plan, offset: int = 0):
+    """Global (block_idx, start, end) triples → task-local indices +
+    the ordered list of source blocks a slice task actually needs
+    (offset shifts local indices past leading fixed args)."""
+    needed = sorted({i for i, _, _ in plan})
+    remap = {i: j + offset for j, i in enumerate(needed)}
+    local = [(remap[i], s, e) for i, s, e in plan]
+    return local, needed
+
+
+@ray_tpu.remote
+def _zip_blocks(plan, my_block, *other_blocks):
+    """Pair my_block's rows with the other dataset's aligned slice
+    (plan entries index into other_blocks, 1-based after my_block)."""
+    mine = list(block_rows(my_block))
+    theirs = []
+    for idx, start, end in plan:
+        theirs.extend(list(block_rows(other_blocks[idx - 1]))[start:end])
+    if len(mine) != len(theirs):
+        raise ValueError(f"zip misalignment: {len(mine)} vs {len(theirs)}")
+    return list(zip(mine, theirs))
+
+
 @ray_tpu.remote
 def _numeric_agg_block(block, column):
     """Per-block numeric partials: (count, sum, min, max)."""
@@ -421,6 +444,31 @@ class Dataset:
                 break
         return Dataset(out if out else [ray_tpu.put([])])
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Pairwise-combine rows of two equal-length datasets into
+        (row_a, row_b) tuples (reference: Dataset.zip).  The other
+        dataset repartitions to THIS dataset's block cuts, so the
+        combine itself is one task per block with no row movement for
+        self."""
+        counts = self._block_counts()
+        ocounts = other._block_counts()
+        if sum(counts) != sum(ocounts):
+            raise ValueError(
+                f"zip needs equal row counts: {sum(counts)} vs {sum(ocounts)}"
+            )
+        cuts = list(np.cumsum(counts)[:-1])
+        plans = other._slice_plans(cuts, ocounts)
+        blocks = self._blocks
+        out = []
+        for my_block, plan in zip(blocks, plans):
+            local, needed = _compact_plan(plan, offset=1)
+            out.append(
+                _zip_blocks.remote(
+                    local, my_block, *[other._blocks[i] for i in needed]
+                )
+            )
+        return Dataset(out)
+
     # -------------------------------------------------------- aggregates
 
     def _numeric_agg(self, column: Optional[str]):
@@ -492,9 +540,7 @@ class Dataset:
         plans = self._slice_plans(cuts, counts)
         out = []
         for plan in plans:
-            needed = sorted({i for i, _, _ in plan})
-            remap = {i: j for j, i in enumerate(needed)}
-            local = [(remap[i], s, e) for i, s, e in plan]
+            local, needed = _compact_plan(plan)
             out.append(
                 _slice_concat.remote(local, *[self._blocks[i] for i in needed])
             )
@@ -804,6 +850,32 @@ class GroupedDataset:
                 "mean": sum(r[_c] for r in rows) / len(rows),
             }
         )
+
+    def min(self, column: str) -> Dataset:
+        return self._run(
+            lambda k, rows, _c=column: {"key": k, "min": min(r[_c] for r in rows)}
+        )
+
+    def max(self, column: str) -> Dataset:
+        return self._run(
+            lambda k, rows, _c=column: {"key": k, "max": max(r[_c] for r in rows)}
+        )
+
+    def std(self, column: str, ddof: int = 1) -> Dataset:
+        """Sample std by default (ddof=1), matching the reference
+        GroupedData.std; single-row groups yield 0.0 like the reference's
+        NaN-avoidance behavior."""
+
+        def _std(k, rows, _c=column, _d=ddof):
+            vals = [float(r[_c]) for r in rows]
+            m = sum(vals) / len(vals)
+            denom = max(len(vals) - _d, 1)
+            return {
+                "key": k,
+                "std": (sum((v - m) ** 2 for v in vals) / denom) ** 0.5,
+            }
+
+        return self._run(_std)
 
 
 class ActorPoolStrategy:

@@ -129,6 +129,32 @@ def test_flat_map_union_limit_aggregates(ray_cluster):
     assert rows.mean("v") == 3.0
 
 
+def test_groupby_min_max_std(ray_cluster):
+    rows = [{"g": i % 2, "v": float(i)} for i in range(10)]
+    gd = rdata.from_items(rows, parallelism=3).groupby("g")
+    mins = {r["key"]: r["min"] for r in gd.min("v").take_all()}
+    maxs = {r["key"]: r["max"] for r in gd.max("v").take_all()}
+    assert mins == {0: 0.0, 1: 1.0} and maxs == {0: 8.0, 1: 9.0}
+    stds = {r["key"]: round(r["std"], 4) for r in gd.std("v").take_all()}
+    # sample std (ddof=1) by default, matching the reference
+    assert stds == {0: round(np.std([0, 2, 4, 6, 8], ddof=1), 4),
+                    1: round(np.std([1, 3, 5, 7, 9], ddof=1), 4)}
+    pop = {r["key"]: round(r["std"], 4) for r in gd.std("v", ddof=0).take_all()}
+    assert pop == {0: round(np.std([0, 2, 4, 6, 8]), 4),
+                   1: round(np.std([1, 3, 5, 7, 9]), 4)}
+
+
+def test_zip_pairs_rows_across_block_layouts(ray_cluster):
+    """zip aligns two datasets with DIFFERENT block cuts (reference:
+    Dataset.zip) and rejects length mismatches."""
+    a = rdata.range(12, parallelism=3)      # blocks of 4
+    b = rdata.range(12, parallelism=4).map(lambda x: x * 100)  # blocks of 3
+    z = a.zip(b)
+    assert z.take_all() == [(i, i * 100) for i in range(12)]
+    with pytest.raises(ValueError, match="equal row counts"):
+        rdata.range(5).zip(rdata.range(6))
+
+
 def test_iter_torch_batches(ray_cluster):
     import torch
 
