@@ -87,10 +87,13 @@ def _compact_plan(plan, offset: int = 0):
 def _zip_blocks(plan, my_block, *other_blocks):
     """Pair my_block's rows with the other dataset's aligned slice
     (plan entries index into other_blocks, 1-based after my_block)."""
+    from ray_tpu.data.block import block_slice
+
     mine = list(block_rows(my_block))
     theirs = []
     for idx, start, end in plan:
-        theirs.extend(list(block_rows(other_blocks[idx - 1]))[start:end])
+        # slice FIRST (zero-copy for arrow blocks), then materialize rows
+        theirs.extend(block_rows(block_slice(other_blocks[idx - 1], start, end)))
     if len(mine) != len(theirs):
         raise ValueError(f"zip misalignment: {len(mine)} vs {len(theirs)}")
     return list(zip(mine, theirs))

@@ -26,6 +26,8 @@ class Replica:
             self.instance = cls_or_fn
         self.inflight = 0
         self.handled = 0
+        self._streams: Dict[int, Any] = {}
+        self._next_stream = 1
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -46,6 +48,78 @@ class Replica:
             return result
         finally:
             self.inflight -= 1
+
+    async def handle_stream_start(self, method: str, args, kwargs):
+        """Start a streaming call: the target returns a (sync or async)
+        generator; chunks are pulled with handle_stream_next (reference:
+        serve streaming responses / StreamingResponse — their proxy
+        iterates the generator; here the HANDLE pulls batches so the
+        stream flows through the normal actor-call path)."""
+        import inspect
+
+        self.inflight += 1
+        try:
+            target = (
+                self.instance if method == "__call__" else getattr(self.instance, method)
+            )
+            gen = target(*args, **kwargs)
+            if inspect.iscoroutine(gen):
+                gen = await gen
+        except BaseException:
+            self.inflight -= 1  # a failed start must not pin the replica busy
+            raise
+        sid = self._next_stream
+        self._next_stream += 1
+        self._streams[sid] = gen
+        return sid
+
+    async def handle_stream_next(self, sid: int, max_chunks: int = 16):
+        """Pull up to max_chunks items; returns (chunks, done)."""
+        import inspect
+
+        gen = self._streams.get(sid)
+        if gen is None:
+            return [], True
+        chunks = []
+        done = False
+        try:
+            for _ in range(max_chunks):
+                if inspect.isasyncgen(gen):
+                    chunks.append(await gen.__anext__())
+                else:
+                    chunks.append(next(gen))
+        except (StopIteration, StopAsyncIteration):
+            done = True
+        except Exception:
+            done = True
+            self._streams.pop(sid, None)
+            self.inflight -= 1
+            raise
+        if done:
+            self._streams.pop(sid, None)
+            self.inflight -= 1
+            self.handled += 1
+        return chunks, done
+
+    def handle_stream_cancel(self, sid: int):
+        """Abandoned stream (consumer broke out / timed out): drop the
+        generator and release the inflight slot — phantom inflight would
+        otherwise pin autoscaling up and wedge rolling-update drains."""
+        gen = self._streams.pop(sid, None)
+        if gen is None:
+            return False
+        try:
+            close = getattr(gen, "close", None) or getattr(gen, "aclose", None)
+            if close is not None:
+                res = close()
+                if hasattr(res, "__await__"):
+                    import asyncio
+
+                    asyncio.get_event_loop().create_task(res)
+        except Exception:
+            pass
+        self.inflight -= 1
+        return True
 
     def stats(self):
         return {"inflight": self.inflight, "handled": self.handled}

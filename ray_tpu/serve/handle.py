@@ -189,6 +189,16 @@ class DeploymentHandle:
         """Async submit; returns an ObjectRef."""
         return self.method("__call__").remote(*args, **kwargs)
 
+    def stream(self, *args, **kwargs):
+        """Call a GENERATOR deployment method and iterate its chunks as
+        they are produced (reference: serve streaming responses).  The
+        stream is pinned to ONE replica (the generator lives there);
+        chunks are pulled in batches through the normal actor-call path.
+
+        for token in handle.stream(prompt): ...
+        """
+        return self.method("__call__").stream(*args, **kwargs)
+
     def method(self, method_name: str):
         handle = self
 
@@ -206,6 +216,36 @@ class DeploymentHandle:
                 except Exception:
                     handle._release(idx)  # fail open: don't wedge the cap
                 return ref
+
+            def stream(self, *args, **kwargs):
+                import ray_tpu
+
+                idx, replica = handle._pick_replica()
+                sid = None
+                finished = False
+                try:
+                    sid = ray_tpu.get(
+                        replica.handle_stream_start.remote(method_name, args, kwargs),
+                        timeout=600,
+                    )
+                    while True:
+                        chunks, stream_done = ray_tpu.get(
+                            replica.handle_stream_next.remote(sid), timeout=600
+                        )
+                        for c in chunks:
+                            yield c
+                        if stream_done:
+                            finished = True
+                            return
+                finally:
+                    if sid is not None and not finished:
+                        # abandoned mid-stream (break / timeout): release
+                        # the replica-side generator + inflight slot
+                        try:
+                            replica.handle_stream_cancel.remote(sid)
+                        except Exception:
+                            pass
+                    handle._release(idx)
 
         return _Method()
 
