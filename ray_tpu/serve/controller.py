@@ -74,7 +74,10 @@ class Replica:
         return sid
 
     async def handle_stream_next(self, sid: int, max_chunks: int = 16):
-        """Pull up to max_chunks items; returns (chunks, done)."""
+        """Pull up to max_chunks items; returns (chunks, done).  Sync
+        generators advance in an executor thread so a slow next() cannot
+        stall the actor's event loop for other requests."""
+        import asyncio
         import inspect
 
         gen = self._streams.get(sid)
@@ -82,42 +85,57 @@ class Replica:
             return [], True
         chunks = []
         done = False
+
+        def _pull_sync():
+            out = []
+            try:
+                for _ in range(max_chunks):
+                    out.append(next(gen))
+            except StopIteration:
+                return out, True
+            return out, False
+
         try:
-            for _ in range(max_chunks):
-                if inspect.isasyncgen(gen):
-                    chunks.append(await gen.__anext__())
-                else:
-                    chunks.append(next(gen))
-        except (StopIteration, StopAsyncIteration):
-            done = True
+            if inspect.isasyncgen(gen):
+                try:
+                    for _ in range(max_chunks):
+                        chunks.append(await gen.__anext__())
+                except StopAsyncIteration:
+                    done = True
+            else:
+                chunks, done = await asyncio.get_running_loop().run_in_executor(
+                    None, _pull_sync
+                )
         except Exception:
-            done = True
-            self._streams.pop(sid, None)
-            self.inflight -= 1
+            # only the actor still holding the stream releases the slot —
+            # a concurrent cancel may have already popped it
+            if self._streams.pop(sid, None) is not None:
+                self.inflight -= 1
             raise
         if done:
-            self._streams.pop(sid, None)
-            self.inflight -= 1
-            self.handled += 1
+            if self._streams.pop(sid, None) is not None:
+                self.inflight -= 1
+                self.handled += 1
         return chunks, done
 
-    def handle_stream_cancel(self, sid: int):
+    async def handle_stream_cancel(self, sid: int):
         """Abandoned stream (consumer broke out / timed out): drop the
         generator and release the inflight slot — phantom inflight would
-        otherwise pin autoscaling up and wedge rolling-update drains."""
+        otherwise pin autoscaling up and wedge rolling-update drains.
+        Async so generator cleanup (finally blocks releasing e.g. an LLM
+        engine slot) runs properly on the actor's loop."""
+        import inspect
+
         gen = self._streams.pop(sid, None)
         if gen is None:
             return False
         try:
-            close = getattr(gen, "close", None) or getattr(gen, "aclose", None)
-            if close is not None:
-                res = close()
-                if hasattr(res, "__await__"):
-                    import asyncio
-
-                    asyncio.get_event_loop().create_task(res)
+            if inspect.isasyncgen(gen):
+                await gen.aclose()
+            else:
+                gen.close()
         except Exception:
-            pass
+            pass  # racing __anext__ / user finally errors: slot still frees
         self.inflight -= 1
         return True
 

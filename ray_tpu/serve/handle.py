@@ -228,10 +228,16 @@ class DeploymentHandle:
                         replica.handle_stream_start.remote(method_name, args, kwargs),
                         timeout=600,
                     )
+                    # adaptive batch: first pull returns on the FIRST chunk
+                    # (token latency), later pulls grow toward 16 so fast
+                    # generators aren't RPC-bound per item
+                    batch = 1
                     while True:
                         chunks, stream_done = ray_tpu.get(
-                            replica.handle_stream_next.remote(sid), timeout=600
+                            replica.handle_stream_next.remote(sid, batch),
+                            timeout=600,
                         )
+                        batch = min(batch * 2, 16)
                         for c in chunks:
                             yield c
                         if stream_done:

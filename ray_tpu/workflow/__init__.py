@@ -1,5 +1,8 @@
 from ray_tpu.workflow.api import (  # noqa: F401
+    WorkflowCancelledError,
+    cancel,
     get_status,
+    list_all,
     resume,
     run,
     run_async,

@@ -92,9 +92,29 @@ def wait_for_event(poll_fn: Callable[[], Any], *, timeout: Optional[float] = Non
     return EventStep(poll_fn, name=name, timeout=timeout, poll_interval=poll_interval)
 
 
+class WorkflowCancelledError(RuntimeError):
+    pass
+
+
+# workflow_id -> threading.Event set by cancel(); checked between steps
+_cancel_events: dict = {}
+
+
+def _is_cancelled(workflow_id: str, storage: WorkflowStorage) -> bool:
+    """In-process cancel event OR the DURABLE mark — a cancel() issued by
+    ANOTHER process writes STATUS=CANCELED, which stops this executor at
+    the next step boundary too."""
+    ev = _cancel_events.get(workflow_id)
+    if ev is not None and ev.is_set():
+        return True
+    return storage.get(f"{workflow_id}/STATUS") == "CANCELED"
+
+
 def _execute(node: Any, workflow_id: str, path: str, storage: WorkflowStorage) -> Any:
     if not isinstance(node, WorkflowStep):
         return node
+    if _is_cancelled(workflow_id, storage):
+        raise WorkflowCancelledError(workflow_id)
     key = f"{workflow_id}/steps/{node._step_key(path)}"
     if storage.exists(key):
         return storage.get(key)
@@ -124,6 +144,10 @@ def _execute(node: Any, workflow_id: str, path: str, storage: WorkflowStorage) -
         k: _execute(v, workflow_id, f"{path}/kw_{k}:{getattr(v, 'name', '')}", storage)
         for k, v in node.kwargs.items()
     }
+    # re-check after (possibly long) upstream resolution: cancel() during
+    # an argument's step must stop THIS step from launching
+    if _is_cancelled(workflow_id, storage):
+        raise WorkflowCancelledError(workflow_id)
     import ray_tpu
 
     remote_fn = ray_tpu.remote(node.fn)
@@ -139,14 +163,22 @@ def run(dag: WorkflowStep, workflow_id: Optional[str] = None) -> Any:
 
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:8]}"
     storage = _get_storage()
+    # a stale cancel mark/event from a PREVIOUS run of this id must not
+    # instantly kill the fresh run/resume
+    _cancel_events.pop(workflow_id, None)
     storage.put(f"{workflow_id}/STATUS", "RUNNING")
     try:
         result = _execute(dag, workflow_id, dag.name, storage)
         storage.put(f"{workflow_id}/STATUS", "SUCCESSFUL")
         return result
+    except WorkflowCancelledError:
+        storage.put(f"{workflow_id}/STATUS", "CANCELED")
+        raise
     except BaseException:
         storage.put(f"{workflow_id}/STATUS", "FAILED")
         raise
+    finally:
+        _cancel_events.pop(workflow_id, None)
 
 
 def run_async(dag: WorkflowStep, workflow_id: Optional[str] = None):
@@ -166,6 +198,36 @@ def run_async(dag: WorkflowStep, workflow_id: Optional[str] = None):
 def resume(workflow_id: str, dag: WorkflowStep) -> Any:
     """Re-run the DAG; completed steps short-circuit from storage."""
     return run(dag, workflow_id=workflow_id)
+
+
+def cancel(workflow_id: str):
+    """Cancel a running workflow between steps (reference:
+    workflow.cancel): the in-flight step completes and checkpoints, the
+    next step raises WorkflowCancelledError and STATUS becomes CANCELED.
+    The durable mark also stops an executor in ANOTHER process at its
+    next step boundary.  Cancelling a finished workflow is a no-op; if
+    completion races the cancel, completion wins (the result exists)."""
+    import threading
+
+    storage = _get_storage()
+    ev = _cancel_events.setdefault(workflow_id, threading.Event())
+    ev.set()
+    if storage.get(f"{workflow_id}/STATUS") == "RUNNING":
+        storage.put(f"{workflow_id}/STATUS", "CANCELED")
+
+
+def list_all(status_filter: Optional[str] = None):
+    """[(workflow_id, status)] for every workflow in storage (reference:
+    workflow.list_all)."""
+    storage = _get_storage()
+    out = []
+    for key in storage.list_prefix(""):
+        if key.endswith("/STATUS") and key.count("/") == 1:
+            wf = key.split("/", 1)[0]
+            status = storage.get(key)
+            if status_filter is None or status == status_filter:
+                out.append((wf, status))
+    return sorted(out)
 
 
 def get_status(workflow_id: str) -> str:

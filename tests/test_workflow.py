@@ -148,6 +148,61 @@ def test_virtual_actor_state_persists(ray_cluster, tmp_path, monkeypatch):
     assert c2.value() == 17
 
 
+def test_cancel_and_list_all(ray_cluster, tmp_path, monkeypatch):
+    """cancel() stops a running workflow BETWEEN steps (the in-flight
+    step checkpoints; the next raises) and list_all enumerates workflows
+    with status filtering (reference: workflow.cancel/list_all)."""
+    import threading
+    import time
+
+    from ray_tpu import workflow
+
+    monkeypatch.setenv(workflow.api.STORAGE_ENV, str(tmp_path))
+
+    started = threading.Event()
+
+    @workflow.step
+    def slow(x):
+        import time as _t
+
+        _t.sleep(1.0)
+        return x + 1
+
+    @workflow.step
+    def never(x):
+        return x * 100
+
+    # first step signals through a file so the driver knows it's mid-run
+    flag = tmp_path / "started"
+
+    @workflow.step
+    def announce(x):
+        open(flag, "w").write("1")
+        import time as _t
+
+        _t.sleep(1.5)
+        return x
+
+    dag = never.step(slow.step(announce.step(1)))
+    holder = workflow.run_async(dag, workflow_id="wf_cancel_me")
+    deadline = time.time() + 20
+    while not flag.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert flag.exists()
+    workflow.cancel("wf_cancel_me")
+    holder["thread"].join(timeout=30)
+    assert "result" not in holder  # never completed
+    assert workflow.get_status("wf_cancel_me") == "CANCELED"
+
+    # a successful workflow for list_all contrast
+    ok_dag = slow.step(0)
+    workflow.run(ok_dag, workflow_id="wf_ok")
+    all_wfs = dict(workflow.list_all())
+    assert all_wfs["wf_cancel_me"] == "CANCELED"
+    assert all_wfs["wf_ok"] == "SUCCESSFUL"
+    assert dict(workflow.list_all("SUCCESSFUL")) == {"wf_ok": "SUCCESSFUL"}
+
+
 def test_kv_storage_backend(ray_cluster):
     """Workflow state in the head KV (GCS-WAL durable) instead of the
     filesystem."""
