@@ -16,5 +16,6 @@ from ray_tpu.tune.search import (  # noqa: F401
     randint,
     uniform,
 )
+from ray_tpu.tune.run_api import ExperimentAnalysis, run  # noqa: F401
 from ray_tpu.tune.trainable import FunctionTrainable  # noqa: F401
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401

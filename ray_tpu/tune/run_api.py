@@ -1,0 +1,90 @@
+"""Classic ``tune.run`` entry point + ExperimentAnalysis facade.
+
+Analog of the reference's function API (reference: python/ray/tune/
+tune.py:run — the surface most user code calls; the Tuner class is the
+newer layer both APIs share).  Thin by design: run() builds a Tuner and
+wraps its ResultGrid in an ExperimentAnalysis with the accessors the
+classic API promises."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+class ExperimentAnalysis:
+    """best_config / best_result / results over a finished experiment
+    (reference: tune/analysis/experiment_analysis.py)."""
+
+    def __init__(self, grid, metric: str, mode: str):
+        self._grid = grid
+        self.metric = metric
+        self.mode = mode
+
+    @property
+    def trials(self):
+        return self._grid.trials
+
+    @property
+    def results(self):
+        return [t.last_metrics for t in self._grid.trials]
+
+    @property
+    def best_result(self) -> Dict[str, Any]:
+        return self._grid.get_best_result(self.metric, self.mode).metrics
+
+    @property
+    def best_config(self) -> Dict[str, Any]:
+        return self._grid.get_best_result(self.metric, self.mode).config
+
+    def dataframe(self):
+        """Rows of (config + final metrics) per trial; plain list of
+        dicts (no pandas dependency in the image's hot path).  User
+        metrics keep their names; bookkeeping fields only fill keys the
+        trainable didn't report."""
+        out = []
+        for t in self._grid.trials:
+            row = {f"config/{k}": v for k, v in (t.config or {}).items()}
+            row.update(t.last_metrics or {})
+            row.setdefault("trial_id", t.trial_id)
+            row.setdefault("state", t.state)
+            out.append(row)
+        return out
+
+
+def run(
+    trainable: Callable,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    metric: str = "loss",
+    mode: str = "min",
+    scheduler: Any = None,
+    search_alg: Any = None,
+    max_concurrent_trials: int = 4,
+    resources_per_trial: Optional[Dict[str, float]] = None,
+    name: Optional[str] = None,
+    seed: int = 0,
+) -> ExperimentAnalysis:
+    """Run `num_samples` trials of `trainable` over `config` (reference:
+    tune.run) and return an ExperimentAnalysis."""
+    from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+    from ray_tpu.air.config import RunConfig
+
+    tuner = Tuner(
+        trainable,
+        param_space=dict(config or {}),
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            max_concurrent_trials=max_concurrent_trials,
+            scheduler=scheduler,
+            searcher=search_alg,
+            seed=seed,
+        ),
+        run_config=RunConfig(name=name) if name else None,
+        resources_per_trial=resources_per_trial,
+    )
+    grid = tuner.fit()
+    return ExperimentAnalysis(grid, metric, mode)

@@ -244,6 +244,30 @@ def test_tpe_beats_random_on_noisy_objective():
     assert gp_best < rnd_best, (gp_best, rnd_best)
 
 
+def test_classic_tune_run_api(ray_cluster):
+    """tune.run + ExperimentAnalysis (reference: tune/tune.py:run — the
+    classic surface most user code calls)."""
+    from ray_tpu import tune
+
+    def objective(config):
+        from ray_tpu.air import session
+
+        session.report({"loss": (config["x"] - 2.0) ** 2, "x": config["x"]})
+
+    analysis = tune.run(
+        objective,
+        config={"x": tune.grid_search([0.0, 1.0, 2.0, 5.0])},
+        metric="loss",
+        mode="min",
+    )
+    assert analysis.best_config["x"] == 2.0
+    assert analysis.best_result["loss"] == 0.0
+    assert len(analysis.trials) == 4
+    rows = analysis.dataframe()
+    assert {r["config/x"] for r in rows} == {0.0, 1.0, 2.0, 5.0}
+    assert all(r["state"] == "TERMINATED" for r in rows)
+
+
 def test_concurrency_limiter_caps_inflight_suggestions():
     from ray_tpu import tune
     from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
