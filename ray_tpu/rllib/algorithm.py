@@ -69,6 +69,64 @@ class Algorithm:
     def stop(self):
         pass
 
+    # --------------------------------------------------- checkpointing
+    # (reference: Algorithm.save/restore, algorithm.py save_checkpoint —
+    # policy weights + training progress to a directory; restore rebuilds
+    # into a live algorithm of the same config)
+
+    def save(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        policy = getattr(self, "policy", None)
+        state = {
+            "iteration": self.iteration,
+            # FULL learner state when the policy provides it (critics,
+            # target nets, temperatures, optimizer moments) — restoring
+            # only actor weights would silently corrupt continued
+            # training against fresh critics
+            "policy_state": policy.get_state() if hasattr(policy, "get_state") else None,
+            "weights": policy.get_weights() if policy is not None else None,
+            "extra": self._save_extra_state(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=5)
+        os.replace(tmp, path)
+        return path
+
+    def restore(self, checkpoint_path: str):
+        import os
+        import pickle
+
+        if os.path.isdir(checkpoint_path):
+            checkpoint_path = os.path.join(checkpoint_path, "algorithm_state.pkl")
+        with open(checkpoint_path, "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        policy = getattr(self, "policy", None)
+        if state.get("policy_state") is not None and hasattr(policy, "set_state"):
+            policy.set_state(state["policy_state"])
+        elif state.get("weights") is not None and policy is not None:
+            policy.set_weights(state["weights"])
+        self._restore_extra_state(state.get("extra") or {})
+        return self
+
+    def _save_extra_state(self) -> Dict[str, Any]:
+        """Subclass hook (policy-less algorithms like ES add their own
+        learnable state here)."""
+        out = {}
+        for attr in ("total_steps", "total_episodes"):
+            if hasattr(self, attr):
+                out[attr] = getattr(self, attr)
+        return out
+
+    def _restore_extra_state(self, extra: Dict[str, Any]):
+        for k, v in extra.items():
+            setattr(self, k, v)
+
 
 class PPO(Algorithm):
     def __init__(self, config: AlgorithmConfig):

@@ -137,6 +137,21 @@ class DQNPolicy:
 
         self.params = jax.tree.map(jnp.asarray, weights)
 
+    def get_state(self):
+        import jax
+
+        return {
+            a: jax.device_get(getattr(self, a))
+            for a in ("params", "target_params", "opt_state")
+        }
+
+    def set_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        for a in ("params", "target_params", "opt_state"):
+            setattr(self, a, jax.tree.map(jnp.asarray, state[a]))
+
 
 class DQNWorker:
     """Rollout actor for off-policy collection: epsilon-greedy stepping

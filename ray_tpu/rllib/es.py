@@ -114,6 +114,11 @@ class ES(Algorithm):
         self._seed_rng = np.random.default_rng(config.seed + 1)
         self.total_episodes = 0
 
+    def _save_extra_state(self):
+        out = super()._save_extra_state()
+        out["theta"] = self.theta
+        return out
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         t0 = time.time()

@@ -459,3 +459,20 @@ class JaxPolicy:
         import jax
 
         self.params = jax.tree.map(jnp.asarray, weights)
+
+    def get_state(self):
+        """Full learner state (params + optimizer moments) for
+        Algorithm.save checkpoints."""
+        import jax
+
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])

@@ -237,6 +237,25 @@ class SACPolicy:
 
         self.actor_params = jax.tree.map(jnp.asarray, weights)
 
+    _STATE_ATTRS = (
+        "actor_params", "q_params", "q_target", "log_alpha",
+        "actor_opt_state", "critic_opt_state", "alpha_opt_state",
+    )
+
+    def get_state(self):
+        """FULL learner state for checkpointing (critics, targets, alpha,
+        optimizer moments — not just the actor)."""
+        import jax
+
+        return {a: jax.device_get(getattr(self, a)) for a in self._STATE_ATTRS}
+
+    def set_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        for a in self._STATE_ATTRS:
+            setattr(self, a, jax.tree.map(jnp.asarray, state[a]))
+
 
 class SACWorker:
     """Rollout actor for the off-policy continuous-control family:

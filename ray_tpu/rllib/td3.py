@@ -214,6 +214,26 @@ class TD3Policy:
 
         self.actor_params = jax.tree.map(jnp.asarray, weights)
 
+    _STATE_ATTRS = (
+        "actor_params", "q_params", "actor_target", "q_target",
+        "actor_opt_state", "critic_opt_state",
+    )
+
+    def get_state(self):
+        import jax
+
+        state = {a: jax.device_get(getattr(self, a)) for a in self._STATE_ATTRS}
+        state["update_count"] = self.update_count
+        return state
+
+    def set_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        for a in self._STATE_ATTRS:
+            setattr(self, a, jax.tree.map(jnp.asarray, state[a]))
+        self.update_count = state["update_count"]
+
 
 @dataclass
 class TD3Config(AlgorithmConfig):

@@ -169,16 +169,27 @@ def shutdown():
     ray_tpu.kill(controller)
 
 
+_STREAM_END = object()
+
+
 class HTTPProxy:
     """aiohttp ingress actor, one per node (reference:
     _private/http_proxy.py:189,333 — per-node proxies behind the cluster
     LB).  Its DeploymentHandles route local-first: replicas on the
-    proxy's own node are preferred (handle.py _pick_replica)."""
+    proxy's own node are preferred (handle.py _pick_replica).  Requests
+    with ?stream=1 iterate a generator deployment and stream NDJSON."""
 
     def __init__(self, port: int):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.port = port
         self._handles = {}
         self.url = None
+        # stream pulls park threads for the stream's lifetime: isolate
+        # them from the default executor the non-stream path blocks on
+        self._stream_executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="serve-stream"
+        )
 
     async def start(self):
         import json
@@ -210,6 +221,47 @@ class HTTPProxy:
                 body = (await request.read()).decode() or None
             import asyncio
             import functools
+
+            if request.query.get("stream") == "1":
+                # generator deployments stream over HTTP as NDJSON lines
+                # (reference: serve StreamingResponse through the proxy);
+                # pulls run on a DEDICATED executor so parked slow streams
+                # can't starve the default pool the non-stream gets use
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "application/x-ndjson"}
+                )
+                await resp.prepare(request)
+                loop = asyncio.get_running_loop()
+                it = handle.stream(body)
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _STREAM_END
+
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(
+                            self._stream_executor, _next
+                        )
+                        if chunk is _STREAM_END:
+                            break
+                        await resp.write(
+                            (json.dumps(chunk, default=str) + "\n").encode()
+                        )
+                except Exception as e:  # noqa: BLE001 — headers already sent
+                    # mid-stream failure: the status line is gone, so the
+                    # error travels as a final NDJSON line
+                    try:
+                        await resp.write(
+                            (json.dumps({"error": str(e)}) + "\n").encode()
+                        )
+                    except Exception:
+                        pass
+                    it.close()
+                await resp.write_eof()
+                return resp
 
             ref = handle.remote(body)
             loop = asyncio.get_running_loop()

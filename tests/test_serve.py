@@ -345,6 +345,30 @@ def test_user_config_reconfigures_live_replicas(ray_cluster):
     assert cur["token"] == first["token"]
 
 
+def test_http_streaming_endpoint(ray_cluster):
+    """?stream=1 streams a generator deployment as NDJSON lines over
+    HTTP (reference: serve StreamingResponse through the proxy)."""
+    import json as _json
+    import urllib.request
+
+    @serve.deployment(name="http_streamer")
+    def http_streamer(n):
+        for i in range(int(n)):
+            yield {"i": i}
+
+    serve.run(http_streamer.bind())
+    url = serve.start_http_proxy(18124)
+    req = urllib.request.Request(
+        url + "/http_streamer?stream=1",
+        data=_json.dumps(5).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        lines = [l for l in resp.read().decode().splitlines() if l]
+    assert [_json.loads(l)["i"] for l in lines] == [0, 1, 2, 3, 4]
+
+
 def test_per_node_http_proxies(ray_cluster):
     """One proxy actor per alive node (reference: _private/http_proxy.py
     per-node proxies); each serves HTTP on its own port."""
