@@ -1,0 +1,24 @@
+"""Shared example bootstrap for the dev box.
+
+On the shared-tunnel dev host the TPU claim env must be stripped AND the
+jax platform re-pinned (sitecustomize already imported jax under the
+claim env, freezing its platform config).  On a real TPU host none of
+this fires and the scripts use the chips directly."""
+
+import os
+import sys
+
+
+def setup_local_env(device_count: int | None = None):
+    if os.environ.pop("PALLAS_AXON_POOL_IPS", None) is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if device_count:
+        os.environ.setdefault(
+            "XLA_FLAGS", f"--xla_force_host_platform_device_count={device_count}"
+        )
+    # examples run from a source checkout without installation
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,59 @@
+"""Core API tour: tasks, actors, objects, wait, placement groups."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._common import setup_local_env
+
+setup_local_env()
+
+import numpy as np
+
+import ray_tpu
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    print("tasks:", ray_tpu.get([square.remote(i) for i in range(5)]))
+
+    big = ray_tpu.put(np.arange(1_000_000))  # shared-memory object store
+
+    @ray_tpu.remote
+    def total(arr):
+        return int(arr.sum())
+
+    print("zero-copy sum:", ray_tpu.get(total.remote(big)))
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    print("actor:", ray_tpu.get([c.inc.remote() for _ in range(3)][-1]))
+
+    slow = [square.remote(i) for i in range(8)]
+    ready, rest = ray_tpu.wait(slow, num_returns=3)
+    print(f"wait: {len(ready)} ready, {len(rest)} pending")
+
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+    print("placement group ready:", pg.bundle_specs)
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

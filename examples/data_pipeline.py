@@ -1,0 +1,39 @@
+"""Dataset pipeline: lazy fused transforms, shuffle, split for ingest,
+prefetched batch iteration."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._common import setup_local_env
+
+setup_local_env()
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    ds = (
+        rdata.range(10_000, parallelism=8)
+        .map(lambda x: x * 2)          # these three fuse into ONE
+        .filter(lambda x: x % 4 == 0)  # task per block when the
+        .map(lambda x: {"v": x})       # dataset materializes
+    )
+    print(ds)  # still lazy: pending_ops=3
+    print("count:", ds.count(), "mean:", ds.mean("v"))
+
+    shards = ds.random_shuffle(seed=0).split(2)
+    for i, shard in enumerate(shards):
+        batches = list(shard.iter_batches(batch_size=512, prefetch_blocks=2))
+        print(f"worker {i}: {len(batches)} prefetched batches")
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""GPT-2 training step over a dp/fsdp/tp device mesh (tiny config so it
+runs anywhere; swap GPT2Config.gpt2_124m() + real chips for the
+benchmarked path)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._common import setup_local_env
+
+setup_local_env(device_count=8)
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from ray_tpu.models.lm_train import make_train_step, synthetic_batch
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = GPT2Config.tiny(compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2), jax.devices()[:8])
+    bundle = make_train_step(model, mesh, learning_rate=1e-3)
+
+    params, opt_state = bundle.init(jax.random.PRNGKey(0))
+    tokens, targets = synthetic_batch(
+        jax.random.PRNGKey(1), 8, cfg.block_size, cfg.vocab_size
+    )
+    for step in range(5):
+        params, opt_state, metrics = bundle.step(params, opt_state, tokens, targets)
+        print(f"step {step}: loss={float(metrics['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

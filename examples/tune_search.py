@@ -1,0 +1,56 @@
+"""Hyperparameter search: Tuner + ASHA early stopping + the native TPE
+searcher (and the classic tune.run form)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._common import setup_local_env
+
+setup_local_env()
+
+import ray_tpu
+from ray_tpu import tune
+
+
+def objective(config):
+    from ray_tpu.air import session
+
+    acc = 0.0
+    for epoch in range(10):
+        acc += config["lr"] * (1.0 - acc)  # toy learning curve
+        session.report({"accuracy": acc, "epoch": epoch})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    from ray_tpu.tune.schedulers import ASHAScheduler
+    from ray_tpu.tune.search import TPESearcher
+    from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+    tuner = Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=TuneConfig(
+            metric="accuracy", mode="max", num_samples=12,
+            scheduler=ASHAScheduler(metric="accuracy", mode="max", max_t=10),
+            searcher=TPESearcher(n_startup=4, seed=0),
+        ),
+    )
+    best = tuner.fit().get_best_result()
+    print("best lr:", best.config["lr"], "accuracy:", best.metrics["accuracy"])
+
+    # classic surface
+    analysis = tune.run(
+        objective,
+        config={"lr": tune.grid_search([0.01, 0.1, 0.5])},
+        metric="accuracy",
+        mode="max",
+    )
+    print("tune.run best:", analysis.best_config)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

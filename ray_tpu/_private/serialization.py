@@ -85,9 +85,25 @@ def _maybe_install_jax_reducer():
 
     try:
         copyreg.pickle(jax.Array, _reduce_jax_array)
-        # concrete ArrayImpl class is what instances actually carry
-        impl = type(jax.numpy.zeros(()))
-        copyreg.pickle(impl, _reduce_jax_array)
+        # concrete ArrayImpl class is what instances actually carry.
+        # Imported, NOT discovered via type(jnp.zeros(())): creating an
+        # array initializes a backend, and in a process whose TPU-claim
+        # env was stripped AFTER interpreter start that init can hang on
+        # the half-registered device plugin.
+        try:
+            from jax._src.array import ArrayImpl
+        except ImportError:
+            # private path moved (jax upgrade): arrays fall back to
+            # jax's in-band pickling — functional but not zero-copy;
+            # say so instead of degrading silently
+            import warnings
+
+            warnings.warn(
+                "jax._src.array.ArrayImpl not importable; jax arrays will "
+                "serialize in-band (no zero-copy out-of-band buffers)"
+            )
+        else:
+            copyreg.pickle(ArrayImpl, _reduce_jax_array)
     except Exception:
         pass
     _jax_reducer_installed = True
