@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import glob
 import os
+import sys
 import threading
 import time
+import traceback
 from typing import Callable, Dict, List
 
 
@@ -43,11 +45,20 @@ class LogTailer(threading.Thread):
         self._partial: Dict[str, bytes] = {}
 
     def run(self):
+        last_err = None
         while not self.stopped.wait(self.poll_s):
             try:
                 self.scan_once()
-            except Exception:
-                pass
+                last_err = None
+            except Exception as e:  # noqa: BLE001
+                # keep tailing on transient scan errors (rotated file,
+                # session dir teardown) — leave a trace, but only once per
+                # distinct error so a persistent failure doesn't flood
+                # stderr at the poll rate
+                err = f"{type(e).__name__}: {e}"
+                if err != last_err:
+                    last_err = err
+                    traceback.print_exc(file=sys.stderr)
 
     def scan_once(self):
         for path in glob.glob(os.path.join(self.log_dir, self.pattern)):

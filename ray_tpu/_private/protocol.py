@@ -37,7 +37,7 @@ class MsgType(enum.IntEnum):
     REGISTER_NODE = 10
     REGISTER_WORKER = 11
     HEARTBEAT = 12
-    NODE_TABLE = 13
+    NODE_TABLE = 13  # graftlint: disable=protocol-exhaustive -- reserved taxonomy slot (reference gcs_service.proto); clients read node tables via LIST_NODES
     DRAIN_NODE = 14
 
     # tasks (analog: core_worker.proto PushTask, node_manager RequestWorkerLease)
@@ -46,9 +46,14 @@ class MsgType(enum.IntEnum):
     PUSH_TASK = 21
     TASK_DONE = 22
     CANCEL_TASK = 23
-    STEAL_OK = 24
+    STEAL_OK = 24  # graftlint: disable=protocol-exhaustive -- reserved for work stealing (reference task stealing protocol); scheduler does not steal yet
     TASK_BLOCKED = 25  # worker blocked in get(): release its cpu (analog:
-    TASK_UNBLOCKED = 26  # reference NotifyDirectCallTaskBlocked, raylet_client.cc)
+    TASK_UNBLOCKED = 27  # reference NotifyDirectCallTaskBlocked, raylet_client.cc)
+    # NOTE: 26 is taken by SUBMIT_TASKS above.  TASK_UNBLOCKED was
+    # historically also 26, which made IntEnum alias the two members and the
+    # head's handler dict silently dispatched unblock notifications to the
+    # batched-submit handler — the released CPU was never reacquired.
+    # graftlint GL004 (protocol-exhaustive) now rejects duplicate values.
 
     # actors (analog: gcs_service.proto ActorInfoGcsService)
     CREATE_ACTOR = 30
@@ -60,13 +65,13 @@ class MsgType(enum.IntEnum):
 
     # objects (analog: object_manager.proto, core_worker GetObjectStatus)
     PUT_OBJECT = 40
-    GET_OBJECT = 41
+    GET_OBJECT = 41  # graftlint: disable=protocol-exhaustive -- reserved; gets resolve via WAIT_OBJECT + shared-memory mmap, never a payload RPC
     FREE_OBJECT = 42
-    OBJECT_LOCATION = 43
+    OBJECT_LOCATION = 43  # graftlint: disable=protocol-exhaustive -- reserved; the head's object directory answers location queries inside WAIT_OBJECT
     WAIT_OBJECT = 44
     ADD_REF = 45
     REMOVE_REF = 46
-    PIN_OBJECT = 47
+    PIN_OBJECT = 47  # graftlint: disable=protocol-exhaustive -- reserved; pinning rides ADD_REF / task-spec containment, no dedicated frame yet
     OBJECT_PULL = 48  # head → raylet: pull oid from a peer's transfer agent
     OBJECT_DELETE = 49  # head → raylet: drop local copy (+ spill files)
     SPILL_NOTIFY = 90  # any store claimant → head: these oids now live on disk
@@ -85,7 +90,7 @@ class MsgType(enum.IntEnum):
     KV_EXISTS = 54
     SUBSCRIBE = 55
     PUBLISH = 56
-    PUBSUB_POLL = 57
+    PUBSUB_POLL = 57  # graftlint: disable=protocol-exhaustive -- reserved; subscribers get pushed PUBLISH frames, long-poll fallback not implemented
 
     # placement groups (analog: gcs_service.proto PlacementGroupInfoGcsService)
     CREATE_PG = 60
@@ -106,7 +111,7 @@ class MsgType(enum.IntEnum):
     RECORD_EVENT = 78  # any process → head: append to the cluster-event ring
 
     # errors pushed to driver
-    ERROR_PUSH = 80
+    ERROR_PUSH = 80  # graftlint: disable=protocol-exhaustive -- reserved; task errors reach drivers as stored RayTaskError values, not pushed frames
 
 
 def _default(obj):
@@ -203,7 +208,9 @@ class Connection:
             self._closed = True
             try:
                 self.writer.close()
-            except Exception:
+            except (OSError, RuntimeError):
+                # best-effort close of an already-broken transport; the
+                # pending-future sweep below is what callers observe
                 pass
             for fut in self._pending.values():
                 if not fut.done():

@@ -88,7 +88,7 @@ def bench_many_actors(budget_s: float = 120.0, batch: int = 50, cap: int = 1_000
     for a in actors:
         try:
             ray_tpu.kill(a)
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- best-effort teardown in a benchmark helper
             pass
     return {
         "actors": n,
@@ -136,7 +136,7 @@ def bench_broadcast(mb: int = 100, nodes: int = 4) -> dict:
     finally:
         try:
             ray_tpu.shutdown()
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- best-effort teardown in a benchmark helper
             pass
         c.shutdown()
 

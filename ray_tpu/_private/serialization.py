@@ -104,8 +104,15 @@ def _maybe_install_jax_reducer():
             )
         else:
             copyreg.pickle(ArrayImpl, _reduce_jax_array)
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001
+        import warnings
+
+        warnings.warn(
+            f"installing the zero-copy jax.Array reducer failed "
+            f"({type(e).__name__}: {e}); jax arrays fall back to in-band "
+            "pickling",
+            stacklevel=2,
+        )
     _jax_reducer_installed = True
 
 

@@ -128,8 +128,10 @@ def init(
 
         try:
             cw.subscribe("logs", _on_log)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"ray_tpu: worker-log streaming unavailable: {e}", file=sys.stderr
+            )
     atexit.register(shutdown)
     return RuntimeContext(global_worker)
 
@@ -167,10 +169,12 @@ def _start_head(
     if system_config:
         env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(system_config)
     log_path = os.path.join(session_dir, "head.log")
-    logf = open(log_path, "ab")
-    proc = subprocess.Popen(
-        cmd, env=env, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
-    )
+    with open(log_path, "ab") as logf:
+        # the child holds its own dup of the fd; keeping ours open would
+        # leak one fd per init() for the life of the driver
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
+        )
     global_worker.head_proc = proc
     # wait for "PORT <n>"
     deadline = time.time() + 30
@@ -194,25 +198,24 @@ def shutdown():
     if cw is not None:
         try:
             cw.disconnect()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
         global_worker.core_worker = None
     proc = global_worker.head_proc
     if proc is not None:
         try:
             proc.terminate()
             proc.wait(timeout=5)
-        except Exception:
+        except (subprocess.TimeoutExpired, OSError):
             try:
                 proc.kill()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already gone
         global_worker.head_proc = None
     global_worker.mode = None
-    try:
-        atexit.unregister(shutdown)
-    except Exception:
-        pass
+    atexit.unregister(shutdown)
 
 
 def is_initialized() -> bool:

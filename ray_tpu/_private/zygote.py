@@ -130,7 +130,15 @@ class ZygoteSpawner:
                 (n,) = _LEN.unpack(hdr)
                 reply = json.loads(self._proc.stdout.read(n))
                 return int(reply["pid"])
-            except Exception:
+            except Exception as e:  # noqa: BLE001
+                # zygote path is an optimization: fall back to exec — but
+                # audibly, because silent 30ms→1s spawn regressions hide here
+                print(
+                    f"zygote spawn failed ({type(e).__name__}: {e}); "
+                    "falling back to exec",
+                    file=sys.stderr,
+                    flush=True,
+                )
                 try:
                     if self._proc is not None:
                         self._proc.kill()
@@ -145,8 +153,8 @@ class ZygoteSpawner:
                 try:
                     self._proc.stdin.close()
                     self._proc.terminate()
-                except Exception:
-                    pass
+                except (OSError, ValueError):
+                    pass  # pipe already closed / process already gone
                 self._proc = None
 
 

@@ -37,8 +37,10 @@ class FakeMultiNodeProvider(NodeProvider):
             "--session-dir",
             self.session_dir,
         ]
-        logf = open(os.path.join(self.session_dir, "autoscaled.log"), "ab")
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf)
+        with open(os.path.join(self.session_dir, "autoscaled.log"), "ab") as logf:
+            # the child keeps its own dup; closing ours avoids one leaked
+            # fd per autoscaled node
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf)
         deadline = time.time() + 30
         while time.time() < deadline:
             line = proc.stdout.readline()

@@ -216,8 +216,8 @@ class CoreWorker:
                     for cb in list(self._subscriptions.get(payload.get("channel", ""), [])):
                         try:
                             cb(payload.get("message", {}))
-                        except Exception:
-                            pass
+                        except Exception:  # noqa: BLE001
+                            logger.exception("pubsub subscriber callback raised")
                 elif msg_type == MsgType.CANCEL_TASK and self._push_task_handler:
                     self._push_task_handler({"cancel": payload.get("task_id")})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -225,8 +225,8 @@ class CoreWorker:
             for cb in list(self._disconnect_cbs):
                 try:
                     cb()
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001
+                    logger.exception("disconnect callback raised")
 
     def on_disconnect(self, cb: Callable[[], None]):
         """Invoke cb (io thread) when the head connection drops — a worker
@@ -239,8 +239,8 @@ class CoreWorker:
         if not self.connected:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("disconnect callback raised (immediate fire)")
 
     async def _heartbeat_loop(self):
         period = RayConfig.heartbeat_period_ms / 1000.0
@@ -267,14 +267,14 @@ class CoreWorker:
             if adds:
                 try:
                     await self.conn.request(MsgType.ADD_REF, {"object_ids": adds}, 10)
-                except Exception:
+                except Exception:  # graftlint: disable=silent-except -- head connection lost; the disconnect callback path owns shutdown
                     pass
             if removals:
                 try:
                     await self.conn.request(
                         MsgType.REMOVE_REF, {"object_ids": removals}, 10
                     )
-                except Exception:
+                except Exception:  # graftlint: disable=silent-except -- head connection lost; the disconnect callback path owns shutdown
                     pass
 
     # ------------------------------------------------------------- refcounts
@@ -625,7 +625,7 @@ class CoreWorker:
                     {"task_id": self.current_task_id},
                 )
             )
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- blocked-notify is advisory cpu accounting; worst case the head keeps the slot held
             pass
 
     def wait(
@@ -669,7 +669,7 @@ class CoreWorker:
                     return
                 try:
                     kind, value = "reply", f.result()
-                except BaseException as e:  # noqa: BLE001
+                except BaseException as e:  # graftlint: disable=silent-except -- error captured into `value` and delivered to the waiting thread below
                     kind, value = "error", e
                 with self._direct_cv:
                     if gen != head_state["gen"]:
@@ -829,7 +829,7 @@ class CoreWorker:
         if adds:
             try:
                 self.request(MsgType.ADD_REF, {"object_ids": adds})
-            except Exception:
+            except Exception:  # graftlint: disable=silent-except -- head connection lost; refs die with the head anyway
                 pass
 
     def free(self, refs: Sequence[ObjectRef]):
@@ -1033,7 +1033,7 @@ class CoreWorker:
             return None  # known not-ALIVE: skip the probe, head path
         try:
             reply = self.request(MsgType.ACTOR_STATE, {"actor_id": actor_id})
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- probe failure falls back to the head routing path
             return None
         addr = reply.get("direct_addr") or ""
         if reply.get("state") != "ALIVE" or not addr:
@@ -1047,7 +1047,7 @@ class CoreWorker:
             conn = self.io.call(
                 Connection.connect(host, int(port_s), RayConfig.connect_timeout_s)
             )
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- negative-cached below; calls route via the head meanwhile
             # unreachable direct port (e.g. filtered cross-node): negative-
             # cache so every call doesn't pay a connect timeout
             self._direct_probe_at[actor_id] = time.monotonic()
@@ -1069,7 +1069,7 @@ class CoreWorker:
 
         try:
             self.subscribe("actor", _on_actor_event)
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- flag reset below retries the subscription on the next direct-call probe
             self._actor_events_subscribed = False
 
     async def _direct_read_loop(self, conn: Connection):
@@ -1085,7 +1085,7 @@ class CoreWorker:
             reply = await conn.request(
                 MsgType.ACTOR_CALL, {"spec": spec.to_wire()}, timeout=None
             )
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- converted to a stored RayTaskError below; the caller raises it on get()
             # conn died mid-call (actor crash/restart/migration): in-flight
             # actor calls fail — NEVER resubmit, the method may have side
             # effects and already run (reference semantics: actor death
@@ -1132,8 +1132,8 @@ class CoreWorker:
         for cb in cbs:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("object-done callback raised")
 
     def on_object_done(self, ref: ObjectRef, cb: Callable[[], None]):
         """Invoke cb() once (from the io thread, or inline if already
@@ -1171,7 +1171,7 @@ class CoreWorker:
             await self.conn.request(
                 MsgType.WAIT_OBJECT, {"object_id": oid, "timeout": None}, 3600
             )
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- watch is best-effort; callbacks fire regardless so waiters re-check the store
             pass
         self._fire_done_callbacks(oid)
 
@@ -1299,7 +1299,7 @@ class CoreWorker:
                     },
                 )
             )
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- event emission is best-effort; store pressure must never fail a put
             pass
 
     def _spill_hook(self, need: int) -> bool:
@@ -1401,16 +1401,16 @@ class CoreWorker:
         for c in list(self._direct_conns.values()):
             try:
                 c.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # already-dead transport; disconnect continues
         self._direct_conns.clear()
         try:
             self.conn.close()
-        except Exception:
-            pass
+        except (OSError, RuntimeError):
+            pass  # already-dead transport; disconnect continues
         try:
             if self.store:
                 self.store.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            logger.debug("store close failed at disconnect", exc_info=True)
         self.io.stop()

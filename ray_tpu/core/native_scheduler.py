@@ -164,5 +164,5 @@ class NativeScheduler:
     def __del__(self):
         try:
             self._lib.sched_destroy(self._h)
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- interpreter-teardown __del__; the lib may already be unloaded
             pass

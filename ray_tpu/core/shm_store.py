@@ -20,6 +20,8 @@ import logging
 import mmap
 import os
 import struct
+import sys
+import traceback
 from typing import List, Optional
 
 import msgpack
@@ -104,6 +106,11 @@ class _Lib:
         return cls._instance
 
 
+# memoryview() only delegates to a Python-level __buffer__ from 3.12 on
+# (PEP 688); before that, readers must fall back to copying under the pin
+_MEMORYVIEW_DELEGATES = sys.version_info >= (3, 12)
+
+
 class _PinnedRegion:
     """Buffer-protocol exporter that releases the store pin when collected.
 
@@ -111,6 +118,12 @@ class _PinnedRegion:
     alive, so the pin (store refcount) outlives every zero-copy consumer —
     the moral equivalent of plasma's client-side release tracking
     (reference: plasma/client.cc Release).
+
+    On Python < 3.12 ``memoryview(region)`` raises TypeError (PEP 688 is
+    3.12+), so callers there read through ``region._view`` and COPY the
+    bytes out while the region object — and therefore the pin — is still
+    alive: correct on every version, zero-copy where the interpreter
+    allows it.
     """
 
     def __init__(self, store: "ShmObjectStore", oid: bytes, view: memoryview):
@@ -124,7 +137,7 @@ class _PinnedRegion:
     def __del__(self):
         try:
             self._store.release(self._oid)
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- interpreter-teardown __del__; the segment may already be unmapped
             pass
 
 
@@ -235,7 +248,12 @@ class ShmObjectStore:
         if rc != 0:
             return None
         region = _PinnedRegion(self, object_id, self._mv[off.value : off.value + size.value])
-        view = memoryview(region)
+        if _MEMORYVIEW_DELEGATES:
+            view = memoryview(region)  # slices keep `region` (the pin) alive
+            copy_out = False
+        else:
+            view = region._view  # `region` local holds the pin while we read
+            copy_out = True
         (hlen,) = _U32.unpack(view[: _U32.size])
         pos = _U32.size
         metadata, inband_len, buf_lens = msgpack.unpackb(
@@ -246,7 +264,8 @@ class ShmObjectStore:
         pos = _pad(pos + inband_len)
         buffers: List[memoryview] = []
         for blen in buf_lens:
-            buffers.append(view[pos : pos + blen])
+            chunk = view[pos : pos + blen]
+            buffers.append(memoryview(bytes(chunk)) if copy_out else chunk)
             pos = _pad(pos + blen)
         return SerializedObject(bytes(metadata), inband, buffers)
 
@@ -264,7 +283,11 @@ class ShmObjectStore:
         if rc != 0:
             return None
         region = _PinnedRegion(self, object_id, self._mv[off.value : off.value + size.value])
-        return memoryview(region)
+        if _MEMORYVIEW_DELEGATES:
+            return memoryview(region)
+        # pre-3.12: copy the wire image out under the pin (`region` lives
+        # until after bytes() completes), then let the pin drop
+        return memoryview(bytes(region._view))
 
     def raw_create(self, object_id: bytes, size: int) -> Optional[memoryview]:
         """Allocate an unsealed object of `size` bytes and return a writable
@@ -299,7 +322,10 @@ class ShmObjectStore:
                 return rc
             try:
                 made_room = self.spill_hook(size)
-            except Exception:
+            except Exception:  # noqa: BLE001
+                # a broken spill hook must not fail the alloc (the evicting
+                # fallback below still runs) — but it must not be invisible
+                traceback.print_exc(file=sys.stderr)
                 made_room = False
             if not made_room:
                 break
@@ -334,7 +360,7 @@ class ShmObjectStore:
                             "capacity": self.capacity(),
                         },
                     )
-                except Exception:
+                except Exception:  # graftlint: disable=silent-except -- pressure-event emission is best-effort; the alloc itself must proceed
                     pass
         return self._lib.store_alloc(self._handle, object_id, size, off_ref)
 

@@ -200,7 +200,7 @@ class WorkerRuntime:
                         # same containment pin as normal returns
                         contained[oid] = sobj.contained
                 stored_error = True
-            except BaseException:
+            except BaseException:  # graftlint: disable=silent-except -- reflected in stored_error; the print_exc below logs the original failure
                 stored_error = False
             traceback.print_exc(file=sys.stderr)
         finally:

@@ -379,8 +379,8 @@ class HeadServer:
         try:
             mport = await start_metrics_server(self.head_node_id.hex(), self._store)
             node.labels["metrics_addr"] = f"{advertise}:{mport}"
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning("head metrics endpoint unavailable: %s", e)
 
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -427,8 +427,8 @@ class HeadServer:
             try:
                 async with self._compact_lock:
                     self._storage.compact(self._snapshot_tables())
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("final WAL compaction failed at shutdown")
         # kill all worker processes we know about
         for w in list(self.workers.values()):
             try:
@@ -443,12 +443,12 @@ class HeadServer:
             self._server.close()
         try:
             self.object_agent.stop()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            logger.debug("object agent stop failed at shutdown", exc_info=True)
         try:
             self._store.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            logger.debug("store close failed at shutdown", exc_info=True)
 
     # ---------------------------------------------- table persistence (WAL)
 
@@ -461,8 +461,10 @@ class HeadServer:
             return
         try:
             self._storage.append(record)
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            # losing a WAL record silently costs durability on the NEXT
+            # restart; say so loudly even though the live tables are intact
+            logger.exception("WAL append failed; record dropped: %r", record[:1])
 
     def _wal_locs(self, oid: bytes):
         """Idempotent location upsert after any directory mutation."""
@@ -572,7 +574,10 @@ class HeadServer:
                     st["sealed"].discard(oid)
                 elif kind == "head":
                     old_heads.add(bytes(rec[1]))
-            except Exception:
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "skipping corrupt WAL record during replay", exc_info=True
+                )
                 continue
         # ---- materialize
         self.kv.update(st["kv"])
@@ -614,7 +619,12 @@ class HeadServer:
         for oid, wire in st["lineage"].items():
             try:
                 spec = TaskSpec.from_wire(wire)
-            except Exception:
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "dropping undecodable lineage entry for %s during replay",
+                    oid.hex()[:16],
+                    exc_info=True,
+                )
                 continue
             self._record_lineage(spec, len(repr(wire)))
         for oid in (
@@ -638,8 +648,8 @@ class HeadServer:
         # short WAL
         try:
             self._storage.compact(self._snapshot_tables())
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("post-replay WAL compaction failed")
 
     async def _persist_loop(self):
         """Compaction pacing: the WAL already made every mutation durable;
@@ -657,8 +667,8 @@ class HeadServer:
                 # begin_compact can't close the fd mid-fsync
                 async with self._compact_lock:
                     await asyncio.to_thread(self._storage.sync)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("batched WAL fsync failed; retrying next tick")
             grown = self._storage.wal_bytes > 4 * (1 << 20)
             periodic = self._tables_dirty and time.time() - last_compact > 10.0
             if not (grown or periodic):
@@ -711,7 +721,7 @@ class HeadServer:
             if rid:
                 try:
                     await conn.reply(rid, {}, error=f"{type(e).__name__}: {e}")
-                except Exception:
+                except Exception:  # graftlint: disable=silent-except -- error already logged above; the reply transport itself is dead
                     pass
 
     async def _on_disconnect(self, cid: int):
@@ -870,8 +880,8 @@ class HeadServer:
         try:
             if w.conn is not None:
                 w.conn.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            logger.debug("closing dead worker connection failed", exc_info=True)
         # fail or retry its running tasks
         for tid in list(w.running_tasks):
             entry = self.tasks.pop(tid, None)
@@ -1092,6 +1102,12 @@ class HeadServer:
                 try:
                     return await self._pull_to_node(oid, dest_nid)
                 except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "pull of %s to node %s failed: %s",
+                        oid.hex()[:16],
+                        dest_nid.hex()[:8],
+                        e,
+                    )
                     return f"transfer failed: {e}"
                 finally:
                     self._pull_inflight.pop(key, None)
@@ -1130,7 +1146,7 @@ class HeadServer:
                     ok = await asyncio.wait_for(
                         self.object_agent.pull(oid, src.transfer_addr), timeout=300
                     )
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # graftlint: disable=silent-except -- captured into last_err, surfaced as the ObjectLostError below
                     ok, last_err = False, f"{type(e).__name__}: {e}"
                 if ok:
                     self._add_location(oid, dest_nid)
@@ -1145,7 +1161,7 @@ class HeadServer:
                         {"object_id": oid, "src_addr": src.transfer_addr},
                         timeout=310,
                     )
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # graftlint: disable=silent-except -- captured into last_err via the reply dict, surfaced as ObjectLostError
                     reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 if reply.get("ok"):
                     self._add_location(oid, dest_nid)
@@ -1389,6 +1405,11 @@ class HeadServer:
                 )
                 ok = bool(reply.get("ok"))
             except Exception:  # noqa: BLE001
+                logger.warning(
+                    "restore RPC for spilled object %s failed",
+                    oid.hex()[:16],
+                    exc_info=True,
+                )
                 ok = False
         if not ok:
             return f"ObjectLostError: restore of {oid.hex()[:16]} failed"
@@ -2022,7 +2043,7 @@ class HeadServer:
         for cid, conn in list(subs.items()):
             try:
                 await conn.send(MsgType.PUBLISH, {"channel": channel, "message": message})
-            except Exception:
+            except Exception:  # graftlint: disable=silent-except -- dead subscriber is expected churn; pruned from the channel just below
                 dead.append(cid)
         for cid in dead:
             subs.pop(cid, None)
@@ -2485,7 +2506,12 @@ class HeadServer:
                 else spec.to_wire()
             )
             await worker.conn.send(MsgType.PUSH_TASK, {"spec": wire})
-        except Exception:
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "task push to worker %s failed; declaring it dead",
+                worker.worker_id.hex()[:8],
+                exc_info=True,
+            )
             await self._on_worker_dead(worker.worker_id, "push failed")
 
     # ---------------------------------------------------------- maintenance
@@ -2505,7 +2531,7 @@ class HeadServer:
                 import psutil
 
                 usage = psutil.virtual_memory().percent / 100.0
-            except Exception:
+            except Exception:  # graftlint: disable=silent-except -- psutil is optional; without it the OOM monitor degrades to a no-op by design
                 continue
             if os.environ.get("RAY_TPU_TEST_FORCE_MEMORY_PRESSURE"):
                 usage = 1.0

@@ -16,12 +16,15 @@ ones possible (VERDICT r3 weak #8).
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import struct
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class GcsSnapshotStorage:
@@ -44,8 +47,13 @@ class GcsSnapshotStorage:
         try:
             with open(self.path, "rb") as f:
                 return pickle.load(f)
-        except Exception:
-            return None  # torn/corrupt snapshot: start fresh
+        except Exception:  # noqa: BLE001
+            # torn/corrupt snapshot: start fresh — but say so, silently
+            # dropping cluster state is how restarts "lose" actors
+            logger.warning(
+                "GCS snapshot %s is corrupt; starting fresh", self.path, exc_info=True
+            )
+            return None
 
     def delete(self):
         try:
@@ -126,7 +134,14 @@ class GcsWalStorage:
                     break  # torn tail: stop at the last whole record
                 try:
                     records.append(pickle.loads(payload))
-                except Exception:
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "undecodable WAL record in %s after %d records; "
+                        "stopping replay here",
+                        path,
+                        len(records),
+                        exc_info=True,
+                    )
                     break
 
     def load(self) -> Tuple[Optional[Dict[str, Any]], List[Tuple]]:

@@ -59,7 +59,7 @@ async def start_metrics_server(node_id_hex: str, store=None, port: int = 0) -> i
             # connected process (the head/raylet agent itself isn't a
             # driver, so node stats alone are served there)
             body += metrics_mod.prometheus_text()
-        except Exception:
+        except Exception:  # graftlint: disable=silent-except -- disconnected agent serves node stats only, by design (comment above)
             pass
         return web.Response(text=body, content_type="text/plain")
 

@@ -77,7 +77,8 @@ class ObjectTransferAgent:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except (OSError, RuntimeError):
+                # transport already torn down; nothing to clean further
                 pass
 
     # -------------------------------------------------------------- pull side
@@ -140,5 +141,6 @@ class ObjectTransferAgent:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except (OSError, RuntimeError):
+                # transport already torn down; pull outcome was decided above
                 pass

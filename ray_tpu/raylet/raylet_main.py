@@ -19,6 +19,7 @@ import signal
 import subprocess
 import sys
 import threading
+import traceback
 from typing import List
 
 from ray_tpu._private.config import RayConfig
@@ -111,7 +112,8 @@ class Raylet:
 
         try:
             metrics_port = await start_metrics_server(self.node_id.hex(), self.store)
-        except Exception:
+        except Exception as e:  # noqa: BLE001
+            print(f"raylet: metrics endpoint unavailable: {e}", file=sys.stderr)
             metrics_port = 0
 
         conn = await Connection.connect(self.head_host, self.head_port)
@@ -129,7 +131,11 @@ class Raylet:
                 "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
             },
         )
-        assert reply.get("ok")
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"head rejected node registration for {self.node_id.hex()[:8]}: "
+                f"{reply!r}"
+            )
 
         # tail this node's worker logs and relay to the head's "logs"
         # channel (analog: reference log_monitor.py per node)
@@ -205,10 +211,12 @@ class Raylet:
         try:
             ok = await asyncio.wait_for(self.object_agent.pull(oid, src), timeout=300)
             await conn.reply(rid, {"ok": bool(ok)})
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # graftlint: disable=silent-except -- failure forwarded to the head inside the reply payload
             try:
                 await conn.reply(rid, {"ok": False, "error": f"{type(e).__name__}: {e}"})
-            except Exception:
+            except (OSError, RuntimeError):
+                # head connection died while replying; the read loop's
+                # shutdown path owns cleanup
                 pass
 
     async def _handle_restore(self, conn: Connection, rid: int, payload: dict):
@@ -225,7 +233,8 @@ class Raylet:
         ok = await asyncio.get_running_loop().run_in_executor(None, _do)
         try:
             await conn.reply(rid, {"ok": bool(ok)})
-        except Exception:
+        except (OSError, RuntimeError):
+            # head connection died while replying; restore result stands
             pass
 
     def _spawn_worker(self, tpu: bool = False):
@@ -293,13 +302,13 @@ class Raylet:
         try:
             if self.object_agent is not None:
                 self.object_agent.stop()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
         try:
             if self.store is not None:
                 self.store.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
         try:
             os.unlink(self.store_path)
         except OSError:

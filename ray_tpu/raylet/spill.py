@@ -11,6 +11,7 @@ it, after which gets and transfers proceed as if it never left.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional
 
 
@@ -63,7 +64,10 @@ def restore_object(store, oid: bytes, path: str) -> bool:
                 remaining = remaining[n:]
         del remaining, buf
         store.raw_seal(oid)
-    except BaseException:
+    except BaseException:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
         store.raw_abort(oid)
         return False
     return True
@@ -90,6 +94,11 @@ def spill_batch(store, need: int, spill_dir: str, max_n: int = 128) -> dict:
         try:
             path = spill_object(store, oid, spill_dir)
         except Exception:  # noqa: BLE001
+            # a candidate that failed to spill (raced a delete, disk full)
+            # is skipped, not fatal — but disk-full must be visible
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
             path = None
         if path:
             spilled[oid] = path

@@ -1,0 +1,17 @@
+"""graftlint: invariant-checking static analysis for the ray_tpu runtime.
+
+Usage:
+    python -m ray_tpu.tools.graftlint ray_tpu/
+
+Exit status: 0 clean, 1 findings, 2 usage error.  See README.md in this
+directory for the rule catalog and the production incidents each rule
+encodes.
+"""
+
+from ray_tpu.tools.graftlint.core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    lint_paths,
+)
+from ray_tpu.tools.graftlint.reporters import format_json, format_text  # noqa: F401

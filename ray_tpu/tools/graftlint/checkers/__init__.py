@@ -1,0 +1,12 @@
+"""Checker modules self-register on import (see core.register)."""
+
+from ray_tpu.tools.graftlint.checkers import (  # noqa: F401
+    defaults,
+    event_loop,
+    events,
+    exceptions,
+    fork_safety,
+    locks,
+    protocol,
+    resources,
+)

@@ -1,0 +1,57 @@
+"""GL009 mutable-default — shared-state defaults in long-lived processes.
+
+``def f(x=[])`` shares one list across every call for the life of the
+process.  In a runtime whose workers are REUSED across tasks (pool
+workers) and whose servers run for days, a mutable default is cross-task
+state leakage — the same failure class the runtime-env undo machinery
+exists to prevent.  Use ``None`` and materialize inside the function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_FACTORY_NAMES = {"dict", "list", "set", "bytearray"}
+
+
+def _is_mutable_default(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in _FACTORY_NAMES and not node.args and not node.keywords
+    return False
+
+
+@register
+class MutableDefaultChecker(FileChecker):
+    rule = Rule(
+        "GL009",
+        "mutable-default",
+        "no mutable default arguments (shared across calls in reused workers)",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            args = node.args
+            for default in [*args.defaults, *args.kw_defaults]:
+                if default is not None and _is_mutable_default(default):
+                    yield ctx.finding(
+                        self.rule,
+                        default,
+                        f"mutable default argument in `{node.name}(...)` is "
+                        "shared across every call in this (long-lived, "
+                        "task-reusing) process; default to None and build it "
+                        "inside",
+                    )

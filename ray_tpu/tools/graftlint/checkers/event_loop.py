@@ -1,0 +1,120 @@
+"""GL002 loop-blocking-call — no synchronous blocking inside async defs.
+
+The GCS head, raylets, and the worker IO thread each run ONE asyncio
+loop; every control RPC in flight shares it.  A single synchronous
+time.sleep / fsync / subprocess wait inside a handler stalls heartbeats,
+task dispatch, and pubsub for every client at once.  Round 5 paid this
+down twice: WAL fsync was moved off the GCS RPC path onto a persist-tick
+thread, and spill file IO went to run_in_executor.  This rule keeps
+those paths clean.
+
+Nested sync ``def``s and lambdas inside an async function are exempt —
+that's the standard run_in_executor thunk shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    import_aliases,
+    in_scope,
+    register,
+)
+
+_BLOCKING_CALLS = {
+    "time.sleep": "stalls the event loop; use `await asyncio.sleep(...)`",
+    "os.fsync": "disk flush on the RPC path; batch it on a persist thread",
+    "os.fdatasync": "disk flush on the RPC path; batch it on a persist thread",
+    "subprocess.run": "blocks until the child exits; use run_in_executor "
+    "or asyncio.create_subprocess_exec",
+    "subprocess.call": "blocks until the child exits; use run_in_executor",
+    "subprocess.check_call": "blocks until the child exits; use run_in_executor",
+    "subprocess.check_output": "blocks until the child exits; use run_in_executor",
+    "socket.create_connection": "synchronous connect; use asyncio.open_connection",
+    "urllib.request.urlopen": "synchronous HTTP; use an executor",
+    "requests.get": "synchronous HTTP; use an executor",
+    "requests.post": "synchronous HTTP; use an executor",
+}
+
+# bare open() in an async handler is file IO on the loop; small config
+# reads are still a seek+read on a cold page cache
+_OPEN_MESSAGE = (
+    "file IO on the event loop; move it to run_in_executor (round-5 "
+    "incident: WAL fsync on the GCS RPC path froze heartbeats)"
+)
+
+_SCOPE_DIRS = ("gcs", "raylet", "core", "serve", "_private", "util")
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Collect blocking calls in async function bodies, skipping nested
+    sync functions/lambdas (executor thunks run off-loop by design)."""
+
+    def __init__(self, checker, ctx, aliases):
+        self.checker = checker
+        self.ctx = ctx
+        self.aliases = aliases
+        self.findings = []
+        self._async_depth = 0
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._async_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # a sync def nested in an async def is (almost always) an executor
+        # thunk; analyze it as non-async context
+        prev, self._async_depth = self._async_depth, 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self._async_depth = prev
+
+    def visit_Lambda(self, node: ast.Lambda):
+        prev, self._async_depth = self._async_depth, 0
+        self.visit(node.body)
+        self._async_depth = prev
+
+    def visit_Call(self, node: ast.Call):
+        if self._async_depth > 0:
+            name = dotted_name(node.func, self.aliases)
+            if name in _BLOCKING_CALLS:
+                self.findings.append(
+                    self.ctx.finding(
+                        self.checker.rule,
+                        node,
+                        f"{name}() inside an async def: {_BLOCKING_CALLS[name]}",
+                    )
+                )
+            elif name == "open" or name == "io.open":
+                self.findings.append(
+                    self.ctx.finding(
+                        self.checker.rule, node, f"open() inside an async def: {_OPEN_MESSAGE}"
+                    )
+                )
+        self.generic_visit(node)
+
+
+@register
+class LoopBlockingCallChecker(FileChecker):
+    rule = Rule(
+        "GL002",
+        "loop-blocking-call",
+        "no synchronous blocking calls inside asyncio handlers",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx, _SCOPE_DIRS)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        visitor = _AsyncBodyVisitor(self, ctx, import_aliases(ctx.tree))
+        visitor.visit(ctx.tree)
+        yield from visitor.findings

@@ -1,0 +1,133 @@
+"""Error-handling discipline.
+
+GL003 silent-except — the seed tree carried >100 ``except Exception:``
+sites that swallow errors with no trace.  Every round-5 debugging session
+started by hand-bisecting which swallow ate the real failure (the zygote
+EOF, the spill-notify drop, the metrics-agent bind).  A broad except must
+leave evidence: raise, log, record a cluster event, or reply with an
+error — or carry an explicit suppression with a reason.
+
+GL007 no-assert-server — ``assert`` vanishes under ``python -O`` and
+raises bare AssertionError without context when it does fire.  Server
+processes (GCS head, raylet, worker main) must validate with explicit
+raises so the failure survives optimized runs and names what broke.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    in_scope,
+    register,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+# call names that count as "the error left evidence"
+_LOGGING_ATTRS = {
+    "exception",
+    "error",
+    "warning",
+    "critical",
+    "warn",
+    "info",
+    "debug",
+    "log",
+    "print_exc",
+    "print_exception",
+    "_record_event",
+    "record_event",
+}
+_LOGGING_PREFIXES = ("traceback.", "logging.", "warnings.")
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts)
+    return False
+
+
+def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "RECORD_EVENT",
+            "ERROR_REPLY",
+        ):
+            return True  # forwards the error onto the control plane
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            last = name.rsplit(".", 1)[-1]
+            if last in _LOGGING_ATTRS or name.startswith(_LOGGING_PREFIXES):
+                return True
+            # print(..., file=sys.stderr) — worker-log style
+            # conn.reply(..., error=...) — error forwarded to the caller
+            for kw in node.keywords:
+                if kw.arg == "file":
+                    return True
+                if kw.arg == "error" and not (
+                    isinstance(kw.value, ast.Constant) and kw.value.value is None
+                ):
+                    return True
+    return False
+
+
+@register
+class SilentExceptChecker(FileChecker):
+    rule = Rule(
+        "GL003",
+        "silent-except",
+        "broad except must log/raise/record, or carry a suppression reason",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx, ("gcs", "raylet", "core", "_private"))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad_handler(node):
+                if not _leaves_evidence(node):
+                    kind = "bare except" if node.type is None else "broad except"
+                    yield ctx.finding(
+                        self.rule,
+                        node,
+                        f"{kind} swallows the error with no trace: log it, "
+                        "narrow the type, or suppress with a reason "
+                        "(`# graftlint: disable=silent-except -- why`)",
+                    )
+
+
+@register
+class NoAssertServerChecker(FileChecker):
+    rule = Rule(
+        "GL007",
+        "no-assert-server",
+        "no `assert` for runtime validation in server processes",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx, ("gcs", "raylet", "core"))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assert):
+                yield ctx.finding(
+                    self.rule,
+                    node,
+                    "assert is stripped under `python -O` and raises a bare "
+                    "AssertionError; raise an explicit exception that names "
+                    "what broke",
+                )

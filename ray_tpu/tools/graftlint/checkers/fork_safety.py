@@ -1,0 +1,167 @@
+"""Fork-safety rules.
+
+GL001 fork-jax-init — the zygote forks workers from a warm preimported
+interpreter (_private/zygote.py).  JAX backend initialization creates
+helper threads and registers device plugins; doing either before fork()
+— or in a process whose TPU-claim env was stripped after interpreter
+start — produced the round-5 class of wedged workers (fork from a
+threaded process, PJRT init hang on half-registered plugins).  So in the
+fork-sensitive modules (zygote, worker_main, serializers) JAX must never
+be imported at module scope, and backend-initializing calls
+(jax.devices() & friends, jnp array construction) must never run at
+import time.  In zygote.py itself JAX is banned outright — the zygote's
+whole contract is "no threads before fork".
+
+GL010 import-time-thread — same contract, generalized: the zygote
+preimports the entire ray_tpu worker dependency closure, so ANY module
+that starts a thread / executor / timer at import time silently breaks
+fork safety for every pool worker.  Threads must start in functions,
+on first use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    import_aliases,
+    iter_module_scope,
+    register,
+)
+
+# modules that sit on the fork path: the zygote itself, the worker main it
+# forks into, and the serializers that run before a worker's first task
+_FORK_SENSITIVE = {"zygote.py", "worker_main.py", "serialization.py"}
+
+# calls that initialize a JAX backend as a side effect
+_BACKEND_INIT = {
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.default_backend",
+    "jax.device_put",
+}
+
+_THREAD_FACTORIES = {
+    "threading.Thread",
+    "threading.Timer",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "multiprocessing.Process",
+    "multiprocessing.Pool",
+}
+
+
+def _is_jax_import(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, ast.Import):
+        return any(a.name == "jax" or a.name.startswith("jax.") for a in stmt.names)
+    if isinstance(stmt, ast.ImportFrom):
+        mod = stmt.module or ""
+        return stmt.level == 0 and (mod == "jax" or mod.startswith("jax."))
+    return False
+
+
+@register
+class ForkJaxInitChecker(FileChecker):
+    rule = Rule(
+        "GL001",
+        "fork-jax-init",
+        "no JAX import/backend-init reachable from zygote/fork paths",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.basename in _FORK_SENSITIVE
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = import_aliases(ctx.tree)
+        in_zygote = ctx.basename == "zygote.py"
+        reported_import_lines = set()
+
+        # (a) module-scope jax imports and jax/jnp calls run at import
+        # time in every forked child — before the child had any say
+        for stmt in iter_module_scope(ctx.tree):
+            if _is_jax_import(stmt):
+                reported_import_lines.add(stmt.lineno)
+                yield ctx.finding(
+                    self.rule,
+                    stmt,
+                    "jax imported at module scope in a fork-sensitive module: "
+                    "import creates helper threads, breaking fork(); import "
+                    "lazily inside the function that needs it",
+                )
+            elif not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        name = dotted_name(node.func, aliases)
+                        if name.startswith(("jax.", "jnp.")):
+                            yield ctx.finding(
+                                self.rule,
+                                node,
+                                f"{name}() at module scope initializes a JAX "
+                                "backend at import time on the fork path",
+                            )
+
+        # (b) anywhere in these files: calls that force backend init
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func, aliases)
+                if name in _BACKEND_INIT:
+                    yield ctx.finding(
+                        self.rule,
+                        node,
+                        f"{name}() initializes the JAX backend; in a process "
+                        "whose TPU-claim env was stripped after interpreter "
+                        "start this can hang on the half-registered plugin",
+                    )
+
+        # (c) zygote.py: jax must not appear at all, even inside functions
+        # that run pre-fork
+        if in_zygote:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.Import, ast.ImportFrom)) and _is_jax_import(
+                    node
+                ):
+                    if node.lineno not in reported_import_lines:
+                        yield ctx.finding(
+                            self.rule,
+                            node,
+                            "jax import inside zygote.py: the zygote must stay "
+                            "single-threaded until fork(); workers import jax "
+                            "after the fork",
+                        )
+
+
+@register
+class ImportTimeThreadChecker(FileChecker):
+    rule = Rule(
+        "GL010",
+        "import-time-thread",
+        "no thread/executor/timer creation at module import time",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = import_aliases(ctx.tree)
+        for stmt in iter_module_scope(ctx.tree):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func, aliases)
+                    if name in _THREAD_FACTORIES:
+                        yield ctx.finding(
+                            self.rule,
+                            node,
+                            f"{name}(...) at module import time: the zygote "
+                            "preimports this closure, and fork() from a "
+                            "threaded process is undefined behavior — start "
+                            "threads lazily in a function",
+                        )

@@ -1,0 +1,209 @@
+"""GL005 lock-discipline — guarded module-level mutable state.
+
+The raylet spawns workers on executor threads; the GCS head runs persist
+ticks and spill hooks on side threads; core_worker batches ref-adds from
+both the user thread and the IO thread.  Module-level mutable containers
+touched from more than one of those entry points were behind the
+batched-ADD_REF-vs-peer-REMOVE race in round 5.  In a module that
+creates threads, every mutation of a module-level list/dict/set from
+inside a function must happen under a ``with <lock>`` (anything whose
+name contains "lock"), inside a ``*_locked`` method (callers hold the
+lock by convention), or on a variable annotated
+``# graftlint: guarded-by=<lock>`` at its definition.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    import_aliases,
+    in_scope,
+    iter_module_scope,
+    register,
+)
+
+_GUARDED_BY_RE = re.compile(r"#\s*graftlint:\s*guarded-by=")
+
+_MUTABLE_FACTORIES = {
+    "dict",
+    "list",
+    "set",
+    "collections.deque",
+    "collections.defaultdict",
+    "collections.OrderedDict",
+    "collections.Counter",
+}
+
+_THREAD_SOURCES = {
+    "threading.Thread",
+    "threading.Timer",
+    "concurrent.futures.ThreadPoolExecutor",
+}
+
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "popleft",
+    "remove",
+    "discard",
+    "clear",
+    "extend",
+    "insert",
+}
+
+
+def _module_creates_threads(tree: ast.AST, aliases: Dict[str, str]) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func, aliases)
+            if name in _THREAD_SOURCES or name.endswith(".run_in_executor"):
+                return True
+    return False
+
+
+def _mutable_globals(ctx: FileContext, aliases: Dict[str, str]) -> Dict[str, int]:
+    """Module-level names bound to mutable containers, minus annotated ones."""
+    out: Dict[str, int] = {}
+    for stmt in iter_module_scope(ctx.tree):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, v = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            # `_CACHE: Dict[str, int] = {}` — annotated module globals are
+            # the house style; they need the same lock discipline
+            target, v = stmt.target, stmt.value
+        else:
+            continue
+        if not isinstance(target, ast.Name):
+            continue
+        is_mutable = isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)) or (
+            isinstance(v, ast.Call) and dotted_name(v.func, aliases) in _MUTABLE_FACTORIES
+        )
+        if not is_mutable:
+            continue
+        line = ctx.lines[stmt.lineno - 1] if stmt.lineno <= len(ctx.lines) else ""
+        if _GUARDED_BY_RE.search(line):
+            continue
+        out[target.id] = stmt.lineno
+    return out
+
+
+class _GuardVisitor(ast.NodeVisitor):
+    """Find unguarded mutations of the candidate globals inside functions."""
+
+    def __init__(self, checker, ctx, candidates: Dict[str, int]):
+        self.checker = checker
+        self.ctx = ctx
+        self.candidates = candidates
+        self.findings: List[Finding] = []
+        self._with_lock_depth = 0
+        self._fn_stack: List[str] = []
+
+    def _in_guard(self) -> bool:
+        if self._with_lock_depth > 0:
+            return True
+        return any(name.endswith("_locked") for name in self._fn_stack)
+
+    def _visit_with(self, node):
+        is_lock = any(
+            "lock" in dotted_name(item.context_expr.func
+                                  if isinstance(item.context_expr, ast.Call)
+                                  else item.context_expr).lower()
+            for item in node.items
+        )
+        if is_lock:
+            self._with_lock_depth += 1
+        self.generic_visit(node)
+        if is_lock:
+            self._with_lock_depth -= 1
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _enter_fn(self, node):
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _enter_fn
+    visit_AsyncFunctionDef = _enter_fn
+
+    def _flag(self, node: ast.AST, name: str):
+        self.findings.append(
+            self.ctx.finding(
+                self.checker.rule,
+                node,
+                f"module-level mutable `{name}` mutated without a lock in a "
+                "module that spawns threads: guard with `with <lock>:`, move "
+                "the mutation into a `*_locked` method, or annotate the "
+                f"definition with `# graftlint: guarded-by=<lock>`",
+            )
+        )
+
+    def _check_target(self, node: ast.AST, target: ast.expr):
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Name) and base.id in self.candidates:
+            if self._fn_stack and not self._in_guard():
+                self._flag(node, base.id)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.candidates
+        ):
+            if self._fn_stack and not self._in_guard():
+                self._flag(node, f.value.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._check_target(node, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_target(node, node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._check_target(node, t)
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineChecker(FileChecker):
+    rule = Rule(
+        "GL005",
+        "lock-discipline",
+        "module-level mutable state in threaded modules must be lock-guarded",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx, ("gcs", "raylet", "core", "_private"))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = import_aliases(ctx.tree)
+        if not _module_creates_threads(ctx.tree, aliases):
+            return
+        candidates = _mutable_globals(ctx, aliases)
+        if not candidates:
+            return
+        visitor = _GuardVisitor(self, ctx, candidates)
+        visitor.visit(ctx.tree)
+        yield from visitor.findings

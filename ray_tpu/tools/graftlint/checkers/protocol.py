@@ -1,0 +1,187 @@
+"""GL004 protocol-exhaustive — MsgType taxonomy vs. receiving sides.
+
+The control plane is length-prefixed msgpack frames tagged with a
+``MsgType`` IntEnum (_private/protocol.py).  Three statically checkable
+invariants:
+
+1. **No duplicate values.**  IntEnum silently ALIASES members that share
+   a value — the seed tree shipped ``SUBMIT_TASKS = 26`` and
+   ``TASK_UNBLOCKED = 26``, so the head's handler dict registered
+   ``h_task_unblocked`` and then overwrote it with ``h_submit_tasks``
+   under the same key: every worker-unblocked notification was dispatched
+   to the batched-submit handler and the released CPU was never
+   reacquired.  This rule is what catches that class at review time.
+2. **Every reference resolves.**  ``MsgType.X`` where X is not declared
+   raises AttributeError only when the (possibly cold) code path runs.
+3. **Every declared type has a receiving side** — a handler-dict entry or
+   a ``msg_type == MsgType.X`` dispatch comparison somewhere in the tree.
+   Declared-but-unhandled types are dead taxonomy at best, a frame the
+   receiver drops on the floor at worst; mark intentional placeholders
+   with a suppression on the member line.
+
+Runs as a project checker: silently no-ops when the scanned file set has
+no MsgType definition (so scoped runs over a single module stay quiet).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+from ray_tpu.tools.graftlint.core import (
+    FileContext,
+    Finding,
+    ProjectChecker,
+    Rule,
+    dotted_name,
+    register,
+)
+
+# replies are consumed by Connection.dispatch_reply, not a handler table
+_EXEMPT = {"REPLY", "ERROR_REPLY"}
+
+
+def _find_enum(
+    ctxs: Sequence[FileContext],
+) -> Tuple[FileContext, Dict[str, Tuple[int, int]]]:
+    """Locate ``class MsgType`` and return {member: (value, lineno)}.
+
+    Handles the member-definition shapes IntEnum accepts: literal ints,
+    ``enum.auto()`` (last value + 1), and bare-name aliases of an earlier
+    member (which resolve to the SAME value, so the duplicate check
+    catches them).  Computed values we can't resolve get value None —
+    still declared, just exempt from the duplicate check."""
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "MsgType":
+                members: Dict[str, Tuple[int, int]] = {}
+                prev = 0
+                for stmt in node.body:
+                    if not (
+                        isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                    ):
+                        continue
+                    v = stmt.value
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                        value = v.value
+                    elif isinstance(v, ast.Call) and dotted_name(v.func) in (
+                        "auto",
+                        "enum.auto",
+                    ):
+                        value = prev + 1
+                    elif isinstance(v, ast.Name) and v.id in members:
+                        value = members[v.id][0]  # alias — same value
+                    else:
+                        value = None
+                    if value is not None:
+                        prev = value
+                    members[stmt.targets[0].id] = (value, stmt.lineno)
+                return ctx, members
+    return None, {}
+
+
+def _msgtype_attrs(tree: ast.AST) -> Iterator[ast.Attribute]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "MsgType"
+        ):
+            yield node
+
+
+def _receiving_refs(tree: ast.AST) -> Iterator[str]:
+    """Yield member names used in receiving position: keys of a
+    ``*_HANDLERS`` dict literal, or operands of an equality / membership
+    test (dispatch comparisons like ``msg_type == MsgType.X``)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [
+                t.attr if isinstance(t, ast.Attribute) else getattr(t, "id", "")
+                for t in node.targets
+            ]
+            if any("_HANDLERS" in (t or "") for t in targets) and isinstance(
+                node.value, ast.Dict
+            ):
+                for key in node.value.keys:
+                    if (
+                        isinstance(key, ast.Attribute)
+                        and isinstance(key.value, ast.Name)
+                        and key.value.id == "MsgType"
+                    ):
+                        yield key.attr
+        elif isinstance(node, ast.Compare):
+            ops_ok = all(isinstance(op, (ast.Eq, ast.In)) for op in node.ops)
+            if not ops_ok:
+                continue
+            operands: List[ast.expr] = [node.left, *node.comparators]
+            for operand in operands:
+                exprs = (
+                    list(operand.elts)
+                    if isinstance(operand, (ast.Tuple, ast.List, ast.Set))
+                    else [operand]
+                )
+                for e in exprs:
+                    if (
+                        isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "MsgType"
+                    ):
+                        yield e.attr
+
+
+@register
+class ProtocolExhaustiveChecker(ProjectChecker):
+    rule = Rule(
+        "GL004",
+        "protocol-exhaustive",
+        "MsgType: no duplicate values, all refs declared, all types handled",
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterator[Finding]:
+        enum_ctx, members = _find_enum(ctxs)
+        if not members:
+            return
+
+        # (1) duplicate values alias silently under IntEnum
+        by_value: Dict[int, str] = {}
+        for name, (value, lineno) in members.items():
+            if value is None:
+                continue  # computed value we can't resolve statically
+            if value in by_value:
+                yield enum_ctx.finding(
+                    self.rule,
+                    lineno,
+                    f"MsgType.{name} = {value} duplicates MsgType."
+                    f"{by_value[value]}: IntEnum aliases them, so handler "
+                    "dicts keyed on one silently capture the other's frames",
+                )
+            else:
+                by_value[value] = name
+
+        received = set()
+        for ctx in ctxs:
+            # (2) undeclared member references
+            for attr in _msgtype_attrs(ctx.tree):
+                if attr.attr not in members and attr.attr.isupper():
+                    yield ctx.finding(
+                        self.rule,
+                        attr,
+                        f"MsgType.{attr.attr} is not declared in the protocol "
+                        "enum (AttributeError when this path runs)",
+                    )
+            received.update(_receiving_refs(ctx.tree))
+
+        # (3) declared types with no receiving side
+        for name, (value, lineno) in sorted(members.items(), key=lambda kv: kv[1][1]):
+            if name in _EXEMPT or name in received:
+                continue
+            yield enum_ctx.finding(
+                self.rule,
+                lineno,
+                f"MsgType.{name} has no receiving-side handler (no handler-"
+                "table entry or dispatch comparison anywhere in the tree): "
+                "frames of this type are dropped on the floor",
+            )

@@ -1,0 +1,179 @@
+"""GL006 resource-hygiene — files and sockets need an owner.
+
+A raylet leaks one fd per spilled object or one socket per failed pull
+retry until the process hits RLIMIT_NOFILE mid-training.  Every
+``open()`` / ``socket.socket()`` / ``socket.create_connection()`` must
+be (a) the context manager of a ``with``, (b) assigned to a local that
+is ``.close()``d (or wrapped in ``contextlib.closing``) somewhere in the
+same function, (c) stored on ``self``/an object that owns its lifecycle,
+or (d) returned to a caller who takes ownership.  Inline use —
+``json.load(open(p))`` — is always a leak on the error path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from ray_tpu.tools.graftlint.core import (
+    FileChecker,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    import_aliases,
+    in_scope,
+    register,
+)
+
+_OPENERS = {"open", "io.open", "socket.socket", "socket.create_connection"}
+
+
+def _opener_calls(node: ast.expr, aliases) -> List[ast.Call]:
+    """Opener calls within an expression (handles ternaries/boolops)."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and dotted_name(sub.func, aliases) in _OPENERS:
+            out.append(sub)
+    return out
+
+
+def _returned_exprs(expr: ast.expr):
+    """The sub-expressions a `return` hands to the caller directly: the
+    value itself, or the elements of a returned container/ternary.
+    `return fh.read()` returns the READ RESULT, not the handle."""
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for e in expr.elts:
+            yield from _returned_exprs(e)
+    elif isinstance(expr, ast.Dict):
+        for v in expr.values:
+            yield from _returned_exprs(v)
+    elif isinstance(expr, ast.IfExp):
+        yield from _returned_exprs(expr.body)
+        yield from _returned_exprs(expr.orelse)
+    else:
+        yield expr
+
+
+class _FunctionScanner:
+    def __init__(self, checker, ctx, aliases):
+        self.checker = checker
+        self.ctx = ctx
+        self.aliases = aliases
+        self.findings: List[Finding] = []
+
+    def scan(self, fn: ast.AST) -> None:
+        closed: Set[str] = set()
+        returned: Set[str] = set()
+        assigned: Dict[str, ast.Call] = {}
+        inline: List[ast.Call] = []
+        safe: Set[int] = set()  # id() of calls already owned (with/closing/self)
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for call in _opener_calls(item.context_expr, self.aliases):
+                        safe.add(id(call))
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func, self.aliases)
+                if name in ("contextlib.closing", "closing"):
+                    for arg in node.args:
+                        for call in _opener_calls(arg, self.aliases):
+                            safe.add(id(call))
+                if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                    "close",
+                    "detach",
+                ):
+                    base = node.func.value
+                    if isinstance(base, ast.Name):
+                        closed.add(base.id)
+            elif isinstance(node, ast.Assign):
+                calls = _opener_calls(node.value, self.aliases)
+                if calls:
+                    target = node.targets[0]
+                    if isinstance(target, ast.Name):
+                        assigned.setdefault(target.id, calls[0])
+                        for c in calls:
+                            safe.add(id(c))
+                    else:
+                        # self.f = open(...) / container slot: lifecycle owned
+                        # by the object holding it
+                        for c in calls:
+                            safe.add(id(c))
+                elif isinstance(node.value, ast.Name) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets
+                ):
+                    # `self.sock = s` (or a container store of the bare
+                    # name) transfers ownership to the holding object
+                    returned.add(node.value.id)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                # only returning the handle ITSELF (possibly inside a
+                # container) transfers ownership; `return fh.read()` and
+                # `return json.load(open(p))` do not
+                for expr in _returned_exprs(node.value):
+                    if isinstance(expr, ast.Name):
+                        returned.add(expr.id)
+                    elif (
+                        isinstance(expr, ast.Call)
+                        and dotted_name(expr.func, self.aliases) in _OPENERS
+                    ):
+                        safe.add(id(expr))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func, self.aliases)
+                if name in _OPENERS and id(node) not in safe:
+                    inline.append(node)
+
+        for call in inline:
+            name = dotted_name(call.func, self.aliases)
+            self.findings.append(
+                self.ctx.finding(
+                    self.checker.rule,
+                    call,
+                    f"{name}(...) used inline: the handle has no owner and "
+                    "leaks on the error path — use `with` or bind and close it",
+                )
+            )
+        for var, call in assigned.items():
+            if var not in closed and var not in returned:
+                name = dotted_name(call.func, self.aliases)
+                self.findings.append(
+                    self.ctx.finding(
+                        self.checker.rule,
+                        call,
+                        f"`{var} = {name}(...)` is never closed or returned in "
+                        "this function: use `with`, close it in a finally, or "
+                        "hand it to an owner",
+                    )
+                )
+
+
+@register
+class ResourceHygieneChecker(FileChecker):
+    rule = Rule(
+        "GL006",
+        "resource-hygiene",
+        "files/sockets opened without `with`, close, or ownership transfer",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(
+            ctx,
+            ("gcs", "raylet", "core", "_private", "serve", "util", "autoscaler",
+             "dashboard", "workflow", "tools"),
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = import_aliases(ctx.tree)
+        seen: Set[tuple] = set()  # nested defs are walked twice; dedupe
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scanner = _FunctionScanner(self, ctx, aliases)
+                scanner.scan(node)
+                for f in scanner.findings:
+                    key = (f.line, f.col)
+                    if key not in seen:
+                        seen.add(key)
+                        yield f

@@ -1,0 +1,353 @@
+"""graftlint core: file model, rule registry, suppression handling, runner.
+
+graftlint is an AST-based static-analysis pass that encodes the runtime's
+hard-won operational invariants (fork safety, event-loop discipline,
+protocol exhaustiveness, ...) as machine-checkable rules.  Each rule in
+`checkers/` names the production failure mode it prevents — see
+ray_tpu/tools/graftlint/README.md for the catalog.
+
+Design notes:
+
+- Checkers come in two shapes.  A ``FileChecker`` sees one parsed file at
+  a time; a ``ProjectChecker`` sees the whole scanned file set (needed for
+  cross-file invariants like "every MsgType has a receiving-side
+  handler").
+- Suppressions are comments, reviewed like code:
+    ``# graftlint: disable=<rule>[,<rule>...] [-- reason]``
+  suppresses matching findings on its own line and the line below (so
+  both trailing and standalone-comment styles work).
+    ``# graftlint: disable-file=<rule>[,...]``
+  suppresses a rule for the whole file.  ``all`` matches every rule.
+- A file that fails to parse is itself a finding (``parse-error``), not a
+  crash: the lint gate must fail closed on syntactically broken code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)=([A-Za-z0-9_,\-\s]+?)"
+    r"(?:\s*--\s*(?P<reason>.*))?\s*$"
+)
+
+PARSE_ERROR_RULE_ID = "GL000"
+PARSE_ERROR_RULE_NAME = "parse-error"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str  # "GL001"
+    name: str  # "fork-jax-init"
+    summary: str  # one line, shown by --list-rules
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    rule_name: str
+    message: str
+
+    def sort_key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule_id)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "file": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule_id,
+            "name": self.rule_name,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str, tree: ast.AST):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # line -> set of suppressed rule names; "all" suppresses everything
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._scan_suppressions()
+
+    @property
+    def parts(self) -> Tuple[str, ...]:
+        return tuple(self.relpath.split("/"))
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.relpath)
+
+    def _scan_suppressions(self) -> None:
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                self.file_suppressions |= names
+            elif text.lstrip().startswith("#"):
+                # standalone comment line: covers the statement below
+                self.line_suppressions.setdefault(lineno + 1, set()).update(names)
+            else:
+                # trailing comment: covers ONLY its own line — extending to
+                # the next line would silently disable rules on unrelated
+                # code (e.g. the next enum member)
+                self.line_suppressions.setdefault(lineno, set()).update(names)
+
+    def suppressed(self, rule_name: str, line: int) -> bool:
+        if {"all", rule_name} & self.file_suppressions:
+            return True
+        at = self.line_suppressions.get(line, ())
+        return "all" in at or rule_name in at
+
+    def finding(self, rule: Rule, node_or_line, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0)
+        return Finding(self.relpath, line, col, rule.id, rule.name, message)
+
+
+class FileChecker:
+    """Per-file checker: override `rule`, optionally `applies`, and `check`."""
+
+    rule: Rule
+
+    def applies(self, ctx: FileContext) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class ProjectChecker:
+    """Whole-tree checker for cross-file invariants."""
+
+    rule: Rule
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: List[object] = []
+
+
+def register(checker_cls):
+    """Class decorator: instantiate and add to the global registry."""
+    _REGISTRY.append(checker_cls())
+    return checker_cls
+
+
+def all_checkers() -> List[object]:
+    # import for side effect: checker modules self-register
+    from ray_tpu.tools.graftlint import checkers  # noqa: F401
+
+    return list(_REGISTRY)
+
+
+def all_rules() -> List[Rule]:
+    return [c.rule for c in all_checkers()]
+
+
+# --------------------------------------------------------------- AST helpers
+
+
+def dotted_name(node: ast.AST, aliases: Optional[Dict[str, str]] = None) -> str:
+    """Best-effort dotted path of a Name/Attribute chain, resolving
+    module-level import aliases (``import time as t`` makes ``t.sleep``
+    resolve to ``time.sleep``)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        root = node.id
+        if aliases and root in aliases:
+            root = aliases[root]
+        parts.append(root)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to the dotted module/object they were imported as."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def iter_module_scope(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Yield statements executed at import time: the module body plus the
+    bodies of module-level if/try/with blocks — but NOT the guarded
+    ``if __name__ == "__main__"`` block (that only runs as a script)."""
+    stack: List[ast.stmt] = list(tree.body)
+    while stack:
+        stmt = stack.pop(0)
+        if isinstance(stmt, ast.If) and _is_main_guard(stmt.test):
+            stack.extend(stmt.orelse)
+            continue
+        yield stmt
+        if isinstance(stmt, ast.If):
+            stack.extend(stmt.body)
+            stack.extend(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            stack.extend(stmt.body)
+            for h in stmt.handlers:
+                stack.extend(h.body)
+            stack.extend(stmt.orelse)
+            stack.extend(stmt.finalbody)
+        elif isinstance(stmt, ast.With):
+            stack.extend(stmt.body)
+
+
+def _is_main_guard(test: ast.expr) -> bool:
+    return (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and test.left.id == "__name__"
+    )
+
+
+def in_scope(ctx: FileContext, dirnames: Sequence[str]) -> bool:
+    """True when any path component matches one of `dirnames` — how scoped
+    rules decide applicability (works for both the real tree and test
+    fixture trees laid out as tmpdir/gcs/x.py)."""
+    return bool(set(ctx.parts[:-1]) & set(dirnames))
+
+
+# ------------------------------------------------------------------- runner
+
+_SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", "build", "dist", ".eggs"}
+
+
+def collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """Expand files/directories into (abspath, relpath) pairs, sorted.
+    Overlapping arguments (`lint a/ a/b/`) yield each file once."""
+    seen: Set[str] = set()
+    out: List[Tuple[str, str]] = []
+
+    def _add(abspath: str, relpath: str) -> None:
+        if abspath not in seen:
+            seen.add(abspath)
+            out.append((abspath, relpath))
+
+    for p in paths:
+        p = os.path.abspath(p)
+        if not os.path.exists(p):
+            # fail closed: a typo'd path must not make the gate pass
+            # vacuously with "clean"
+            raise OSError(f"no such file or directory: {p}")
+        if os.path.isfile(p):
+            # anchor the relpath above the enclosing package so scoped
+            # rules keep their directory components no matter what cwd the
+            # tool runs from (cwd-relative paths lose them when invoked
+            # from inside the package)
+            root = os.path.dirname(p)
+            while os.path.isfile(os.path.join(root, "__init__.py")):
+                root = os.path.dirname(root)
+            _add(p, os.path.relpath(p, os.path.dirname(root) or root))
+            continue
+        base = os.path.dirname(p.rstrip(os.sep))
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    ap = os.path.join(root, f)
+                    _add(ap, os.path.relpath(ap, base))
+    return out
+
+
+def parse_files(
+    paths: Sequence[str],
+) -> Tuple[List[FileContext], List[Finding]]:
+    ctxs: List[FileContext] = []
+    errors: List[Finding] = []
+    for abspath, relpath in collect_files(paths):
+        try:
+            with open(abspath, "r", encoding="utf-8", errors="replace") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=abspath)
+        except SyntaxError as e:
+            errors.append(
+                Finding(
+                    relpath.replace(os.sep, "/"),
+                    e.lineno or 1,
+                    e.offset or 0,
+                    PARSE_ERROR_RULE_ID,
+                    PARSE_ERROR_RULE_NAME,
+                    f"file does not parse: {e.msg}",
+                )
+            )
+            continue
+        ctxs.append(FileContext(abspath, relpath, source, tree))
+    return ctxs, errors
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    ignore: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run every registered checker over `paths`; returns surviving
+    findings sorted by (file, line).  `select`/`ignore` filter by rule id
+    or name."""
+    ctxs, findings = parse_files(paths)
+    selected = {s for s in (select or ())}
+    ignored = {s for s in (ignore or ())}
+    known = {PARSE_ERROR_RULE_ID, PARSE_ERROR_RULE_NAME}
+    for rule in all_rules():
+        known |= {rule.id, rule.name}
+    unknown = (selected | ignored) - known
+    if unknown:
+        # a typo'd --select must not silently run zero checkers
+        raise ValueError(f"unknown rule id/name: {', '.join(sorted(unknown))}")
+
+    def _wanted(rule: Rule) -> bool:
+        if selected and not ({rule.id, rule.name} & selected):
+            return False
+        return not ({rule.id, rule.name} & ignored)
+
+    for checker in all_checkers():
+        if not _wanted(checker.rule):
+            continue
+        if isinstance(checker, ProjectChecker):
+            raw = checker.check_project(ctxs)
+            by_path = {c.relpath: c for c in ctxs}
+            for f in raw:
+                c = by_path.get(f.path)
+                if c is None or not c.suppressed(f.rule_name, f.line):
+                    findings.append(f)
+        else:
+            for ctx in ctxs:
+                if not checker.applies(ctx):
+                    continue
+                for f in checker.check(ctx):
+                    if not ctx.suppressed(f.rule_name, f.line):
+                        findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return findings

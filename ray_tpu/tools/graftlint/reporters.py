@@ -1,0 +1,43 @@
+"""Finding reporters: human text and machine JSON.
+
+The JSON schema is versioned and consumed by CI annotations and by
+tests/test_graftlint.py — bump "version" on breaking changes.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List
+
+from ray_tpu.tools.graftlint.core import Finding
+
+JSON_SCHEMA_VERSION = 1
+
+
+def format_text(findings: List[Finding], statistics: bool = False) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col}: {f.rule_id} [{f.rule_name}] {f.message}"
+        for f in findings
+    ]
+    if not findings:
+        lines.append("graftlint: clean")
+    if statistics:
+        counts = Counter(f"{f.rule_id} [{f.rule_name}]" for f in findings)
+        lines.append("")
+        for key, n in sorted(counts.items()):
+            lines.append(f"{n:5d}  {key}")
+        lines.append(f"{len(findings):5d}  total")
+    return "\n".join(lines)
+
+
+def format_json(findings: List[Finding]) -> str:
+    counts = Counter(f.rule_name for f in findings)
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "graftlint",
+        "counts": dict(sorted(counts.items())),
+        "total": len(findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=False)

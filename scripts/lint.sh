@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graftlint (repo-invariant rules) + a bytecode
+# compile pass.  Exits nonzero on any new violation — see
+# ray_tpu/tools/graftlint/README.md for the rule catalog and how to
+# suppress intentional findings (with a reason).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== graftlint =="
+JAX_PLATFORMS=cpu python -m ray_tpu.tools.graftlint ray_tpu/ --statistics
+
+echo "== compile check =="
+python -m compileall -q ray_tpu/ tests/ examples/ scripts/
+
+echo "lint: OK"
