@@ -55,6 +55,12 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "actor_max_restarts": (int, 0, "default restarts for actors"),
     "lineage_max_bytes": (int, 64 * 1024 * 1024, "max lineage kept per owner for reconstruction"),
     "max_object_reconstructions": (int, 3, "re-executions allowed to recover a lost object"),
+    "function_fetch_timeout_s": (float, 30.0, "max server-side wait for a function-table KV fetch (widen for chaos/slow CI)"),
+    "object_pull_attempts": (int, 3, "backoff-disciplined attempts for a cross-node object pull before declaring it lost"),
+    # -- fault injection (deterministic chaos; see _private/CHAOS.md) --
+    "chaos_enable": (bool, False, "make this process chaos-aware: subscribe to runtime arm/disarm pushes"),
+    "chaos_seed": (int, 0, "deterministic fault-injection seed (same seed + plan => same per-stream fault sequence)"),
+    "chaos_plan": (str, "", "fault-injection plan string, e.g. 'worker:wire.send.sever@TASK_DONE=0.5'; arms at process start when non-empty"),
     # -- collective / tpu --
     "collective_rendezvous_timeout_s": (float, 120.0, "GCS-KV rendezvous wait"),
     "dcn_allreduce_chunk_bytes": (int, 4 * 1024 * 1024, "ring-allreduce chunk over DCN"),

@@ -20,9 +20,12 @@ import asyncio
 import enum
 import itertools
 import struct
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import msgpack
+
+from ray_tpu._private import chaos
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -113,6 +116,16 @@ class MsgType(enum.IntEnum):
     # errors pushed to driver
     ERROR_PUSH = 80  # graftlint: disable=protocol-exhaustive -- reserved; task errors reach drivers as stored RayTaskError values, not pushed frames
 
+    # fault injection (chaos.py): driver → head arm/disarm, fanned out to
+    # chaos-aware processes over the "chaos" pubsub channel
+    CHAOS_CTRL = 95
+
+
+# Frames the chaos layer never injects into: its own control plane and
+# the structured-event channel fault reports ride on (keep in sync with
+# chaos.EXEMPT_MSG_TYPES, which holds the raw values to avoid a cycle).
+_CHAOS_EXEMPT = frozenset({MsgType.RECORD_EVENT, MsgType.CHAOS_CTRL})
+
 
 def _default(obj):
     raise TypeError(f"Unserializable control-plane value: {type(obj)!r}")
@@ -145,10 +158,35 @@ class Connection:
         self._write_lock = asyncio.Lock()
 
     @classmethod
-    async def connect(cls, host: str, port: int, timeout: float = 10.0) -> "Connection":
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), timeout
-        )
+    async def connect(
+        cls, host: str, port: int, timeout: float = 10.0, retry: bool = True
+    ) -> "Connection":
+        """Dial with bounded full-jitter retry inside the `timeout` window,
+        so a peer that is mid-restart (head failover, raylet respawn)
+        doesn't fail every client at t=0 — and the retries don't
+        synchronize into a reconnect herd.  `retry=False` keeps the old
+        single-attempt fast-fail (direct-call probes want that: an
+        unreachable actor port should negative-cache immediately, not
+        burn the whole dial window)."""
+        deadline = time.monotonic() + timeout
+        backoff = chaos.Backoff(base=0.05, cap=1.0)
+        while True:
+            rem = deadline - time.monotonic()
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), max(rem, 0.05)
+                )
+                break
+            except (OSError, asyncio.TimeoutError) as e:
+                delay = backoff.next_delay()
+                rem = deadline - time.monotonic()
+                if not retry or rem <= 0 or delay is None:
+                    raise ConnectionError(
+                        f"connect to {host}:{port} failed after "
+                        f"{backoff.attempt} attempt(s) within {timeout:.1f}s: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                await asyncio.sleep(min(delay, rem))
         try:
             sock = writer.get_extra_info("socket")
             if sock is not None:
@@ -161,14 +199,41 @@ class Connection:
 
     async def send(self, msg_type: int, payload: Dict[str, Any], request_id: int = 0):
         data = pack(msg_type, request_id, payload)
+        dup = False
+        if chaos.wire_on and msg_type not in _CHAOS_EXEMPT:
+            verdict = chaos.wire_decide("wire.send", int(msg_type))
+            if verdict is not None:
+                action, param = verdict
+                if action == "drop":
+                    return
+                if action == "sever":
+                    self.close()
+                    raise ConnectionError(
+                        f"chaos: connection severed on send({int(msg_type)})"
+                    )
+                if action == "delay":
+                    await asyncio.sleep(param)
+                dup = action == "dup"
         async with self._write_lock:
             self.writer.write(data)
+            if dup:
+                self.writer.write(data)
             await self.writer.drain()
 
     async def request(
         self, msg_type: int, payload: Dict[str, Any], timeout: Optional[float] = None
     ) -> Dict[str, Any]:
         """Send a request and await the paired reply (run read_loop elsewhere)."""
+        if chaos.wire_on and msg_type not in _CHAOS_EXEMPT:
+            verdict = chaos.wire_decide("wire.request", int(msg_type))
+            if verdict is not None:
+                action, param = verdict
+                if action == "fail":
+                    raise ConnectionError(
+                        f"chaos: request({int(msg_type)}) failed before send"
+                    )
+                if action == "delay":
+                    await asyncio.sleep(param)
         rid = next(self._req_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
@@ -196,12 +261,27 @@ class Connection:
         return True
 
     async def read_frame(self) -> Tuple[int, int, Dict[str, Any]]:
-        hdr = await self.reader.readexactly(_LEN.size)
-        (n,) = _LEN.unpack(hdr)
-        if n > MAX_FRAME:
-            raise ConnectionError(f"frame too large: {n}")
-        body = await self.reader.readexactly(n)
-        return unpack(body)
+        while True:
+            hdr = await self.reader.readexactly(_LEN.size)
+            (n,) = _LEN.unpack(hdr)
+            if n > MAX_FRAME:
+                raise ConnectionError(f"frame too large: {n}")
+            body = await self.reader.readexactly(n)
+            frame = unpack(body)
+            if chaos.wire_on and frame[0] not in _CHAOS_EXEMPT:
+                verdict = chaos.wire_decide("wire.read", int(frame[0]))
+                if verdict is not None:
+                    action, param = verdict
+                    if action == "drop":
+                        continue  # frame vanishes; keep reading
+                    if action == "sever":
+                        self.close()
+                        raise ConnectionError(
+                            f"chaos: connection severed on read({int(frame[0])})"
+                        )
+                    if action == "delay":
+                        await asyncio.sleep(param)
+            return frame
 
     def close(self):
         if not self._closed:

@@ -208,11 +208,32 @@ def shutdown():
         try:
             proc.terminate()
             proc.wait(timeout=5)
-        except (subprocess.TimeoutExpired, OSError):
+        except subprocess.TimeoutExpired:
+            # a wedged (or SIGSTOPped) head ignores SIGTERM: escalate to
+            # SIGKILL and REAP, so no zombie outlives the driver — with a
+            # structured breadcrumb, since an escalation here usually means
+            # the head was already sick
+            print(
+                json.dumps(
+                    {
+                        "event": "head_shutdown_escalated",
+                        "pid": proc.pid,
+                        "signal": "SIGKILL",
+                        "after_timeout_s": 5,
+                    }
+                ),
+                file=sys.stderr,
+            )
             try:
                 proc.kill()
-            except OSError:
-                pass  # already gone
+                proc.wait(timeout=5)
+            except (subprocess.TimeoutExpired, OSError):
+                print(
+                    json.dumps({"event": "head_unreapable", "pid": proc.pid}),
+                    file=sys.stderr,
+                )
+        except OSError:
+            pass  # already gone
         global_worker.head_proc = None
     global_worker.mode = None
     atexit.unregister(shutdown)

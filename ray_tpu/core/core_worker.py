@@ -42,6 +42,7 @@ from ray_tpu.core.shm_store import ShmObjectStore
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    HeadUnreachableError,
     ObjectLostError,
     RayActorError,
     RaySystemError,
@@ -175,10 +176,21 @@ class CoreWorker:
 
         self.is_client = False  # remote driver without a local store mmap
         self._client_promoted: set = set()
+        self._conn_lost = False
         self.io = _EventLoopThread()
-        self.conn: Connection = self.io.call(
-            Connection.connect(head_host, head_port, RayConfig.connect_timeout_s)
-        )
+        try:
+            # connect() retries with backoff inside the window, so a head
+            # mid-restart is absorbed; past the window the failure is TYPED,
+            # not a generic timeout 60s later
+            self.conn: Connection = self.io.call(
+                Connection.connect(head_host, head_port, RayConfig.connect_timeout_s)
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            self.io.stop()
+            raise HeadUnreachableError(
+                f"head at {head_host}:{head_port} unreachable within the "
+                f"{RayConfig.connect_timeout_s:.1f}s dial window: {e}"
+            ) from e
         self.store: Optional[ShmObjectStore] = None
         self.io.spawn(self._read_loop())
         self.io.spawn(self._gc_flush_loop())
@@ -188,16 +200,40 @@ class CoreWorker:
             # its tasks (analog: reference gcs_heartbeat_manager.h)
             self.io.spawn(self._heartbeat_loop())
         self.connected = True
+        from ray_tpu._private import chaos
+
+        chaos.maybe_init_from_env("worker" if mode == "worker" else "driver")
         if mode == "driver":
             self.register_as_driver(worker_env or {})
+        if chaos.aware():
+            chaos.set_emitter(self._chaos_emit)
+            self._chaos_sync()
 
     # ------------------------------------------------------------- plumbing
 
     def request(self, msg_type, payload, timeout: Optional[float] = None):
-        """Synchronous control RPC from any thread."""
-        return self.io.call(
-            self.conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
-        )
+        """Synchronous control RPC from any thread.  Fails FAST with a
+        typed HeadUnreachableError once the head connection is known dead
+        — graceful degradation instead of every caller hanging out its
+        full rpc timeout against a severed socket."""
+        if self._conn_lost:
+            raise HeadUnreachableError(
+                f"head connection lost; {MsgType(msg_type).name} unavailable"
+            )
+        try:
+            return self.io.call(
+                self.conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
+            )
+        except ConnectionError as e:
+            # only transport loss converts: a remote ERROR_REPLY also
+            # surfaces as ConnectionError but leaves the conn healthy
+            if isinstance(e, HeadUnreachableError):
+                raise
+            if self._conn_lost or self.conn.closed:
+                raise HeadUnreachableError(
+                    f"head connection lost during {MsgType(msg_type).name}: {e}"
+                ) from e
+            raise
 
     async def _read_loop(self):
         try:
@@ -221,6 +257,7 @@ class CoreWorker:
                 elif msg_type == MsgType.CANCEL_TASK and self._push_task_handler:
                     self._push_task_handler({"cancel": payload.get("task_id")})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self._conn_lost = True
             self.connected = False
             for cb in list(self._disconnect_cbs):
                 try:
@@ -241,6 +278,46 @@ class CoreWorker:
                 cb()
             except Exception:  # noqa: BLE001
                 logger.exception("disconnect callback raised (immediate fire)")
+
+    def _chaos_sync(self):
+        """Late-joiner plan sync + live arm/disarm subscription.  Only runs
+        in chaos-aware processes (RAY_TPU_CHAOS_* env), so the default path
+        pays nothing; a process spawned after a runtime arm picks the plan
+        up from KV, and subsequent arms/disarms arrive over pubsub."""
+        import json as _json
+
+        from ray_tpu._private import chaos
+
+        try:
+            blob = self.kv_get("chaos:plan")
+            if blob:
+                chaos.apply_ctrl(_json.loads(bytes(blob).decode()))
+            self.subscribe("chaos", chaos.apply_ctrl)
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "chaos control-channel sync failed; an env-armed plan (if "
+                "any) stays active, runtime arm/disarm won't reach this "
+                "process",
+                exc_info=True,
+            )
+
+    def _chaos_emit(self, ev: dict):
+        """Fire-and-forget structured event for a fired fault (RECORD_EVENT
+        is exempt from injection, so emission can't recurse)."""
+        try:
+            self.io.spawn(
+                self.conn.send(
+                    MsgType.RECORD_EVENT,
+                    {
+                        "severity": "WARNING",
+                        "source": "chaos",
+                        "message": ev["message"],
+                        "fields": ev["fields"],
+                    },
+                )
+            )
+        except Exception:  # graftlint: disable=silent-except -- fault events are best-effort observability; the local chaos.fired() log is authoritative
+            pass
 
     async def _heartbeat_loop(self):
         period = RayConfig.heartbeat_period_ms / 1000.0
@@ -321,8 +398,14 @@ class CoreWorker:
         if fn is not None:
             return fn
         key = f"fn:{function_id.hex()}"
+        # config-driven (not hardcoded) so chaos runs / slow CI can widen
+        # the window without editing source; the client-side rpc timeout
+        # keeps a margin over the server-side wait
+        fetch_timeout = RayConfig.function_fetch_timeout_s
         reply = self.request(
-            MsgType.KV_GET, {"key": key, "wait": True, "timeout": 30}, timeout=35
+            MsgType.KV_GET,
+            {"key": key, "wait": True, "timeout": fetch_timeout},
+            timeout=fetch_timeout + 5.0,
         )
         if not reply.get("found"):
             raise RaySystemError(f"function {function_id.hex()} not found in table")
@@ -1044,8 +1127,12 @@ class CoreWorker:
         self._direct_probe_at.pop(actor_id, None)
         host, port_s = addr.rsplit(":", 1)
         try:
+            # single attempt (retry=False): an unreachable direct port must
+            # negative-cache fast, not burn the whole dial window per call
             conn = self.io.call(
-                Connection.connect(host, int(port_s), RayConfig.connect_timeout_s)
+                Connection.connect(
+                    host, int(port_s), RayConfig.connect_timeout_s, retry=False
+                )
             )
         except Exception:  # graftlint: disable=silent-except -- negative-cached below; calls route via the head meanwhile
             # unreachable direct port (e.g. filtered cross-node): negative-
@@ -1398,6 +1485,7 @@ class CoreWorker:
 
     def disconnect(self):
         self.connected = False
+        self._conn_lost = True  # post-disconnect RPCs fail fast and typed
         for c in list(self._direct_conns.values()):
             try:
                 c.close()

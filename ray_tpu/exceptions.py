@@ -126,6 +126,15 @@ class RaySystemError(RayError):
     pass
 
 
+class HeadUnreachableError(RaySystemError, ConnectionError):
+    """The head (GCS) could not be reached within the bounded dial /
+    reconnect window.  Typed so callers can tell a briefly-unreachable
+    control plane (retryable, e.g. head mid-restart) from a generic RPC
+    failure — and so nothing hangs on a 60s timeout to learn it.
+    Subclasses ConnectionError so existing transport-error handlers keep
+    catching it."""
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

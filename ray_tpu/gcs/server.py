@@ -34,6 +34,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.protocol import Connection, MsgType
@@ -329,6 +330,10 @@ class HeadServer:
 
     async def start(self) -> int:
         os.makedirs(self.session_dir, exist_ok=True)
+        # chaos scope + env-armed plan; fired faults land in the cluster
+        # event ring directly (this process OWNS the ring)
+        chaos.maybe_init_from_env("head")
+        chaos.set_emitter(self._chaos_emit)
         # head's own node
         res = dict(self._head_resources)
         res.setdefault("CPU", float(os.cpu_count() or 4))
@@ -481,7 +486,10 @@ class HeadServer:
             if pg.state != "REMOVED"
         ]
         return {
-            "kv": dict(self.kv),
+            # the runtime chaos plan ("chaos:plan", written by h_chaos_ctrl
+            # outside the WAL) must not ride the snapshot: a restarted head
+            # comes back fault-free unless the env re-arms it
+            "kv": {k: v for k, v in self.kv.items() if k != "chaos:plan"},
             "jobs": dict(self.jobs),
             "detached_actors": detached,
             "pgs": pgs,
@@ -960,7 +968,14 @@ class HeadServer:
             )
             await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_RESTARTING})
         else:
-            await self._destroy_actor(actor, reason)
+            # terminal: the death cause carries the restart accounting so
+            # the client-side RayActorError says HOW the budget was spent,
+            # not just that the actor is gone
+            await self._destroy_actor(
+                actor,
+                f"{reason} (restarts exhausted: "
+                f"{actor.restarts_used}/{actor.max_restarts})",
+            )
         self._kick_scheduler()
 
     async def _destroy_actor(self, actor: ActorInfo, reason: str):
@@ -1120,20 +1135,50 @@ class HeadServer:
             return "__timeout__"
 
     async def _pull_to_node(self, oid: bytes, dest_nid: bytes) -> Optional[str]:
-        err = await self._pull_to_node_once(oid, dest_nid)
-        if err is None or not err.startswith("ObjectLostError"):
-            return err
-        # a spill may have raced the pull (the holder deleted its shm copy
-        # and its SPILL_NOTIFY is in flight): give the notify a beat, then
-        # restore-and-retry once before declaring the object lost
-        await asyncio.sleep(0.3)
-        if oid in self.object_spilled:
-            rerr = await self._restore_spilled(oid)
-            if rerr is None:
-                if dest_nid in self.object_locations.get(oid, ()):
-                    return None
-                return await self._pull_to_node_once(oid, dest_nid)
-        return err
+        """One logical pull = a bounded, backoff-disciplined sequence of
+        attempts.  Transfer failures against LIVE sources retry with full
+        jitter (a restarting transfer agent or an injected wire fault must
+        not immediately escalate to lineage reconstruction); "no live
+        copy" is not retried — that is reconstruction's job.  The caller's
+        deadline still bounds the whole sequence via _ensure_object_local's
+        wait_for."""
+        # config counts TOTAL pull rounds; Backoff.max_attempts counts
+        # retries (delays granted), hence the -1
+        total_rounds = max(1, RayConfig.object_pull_attempts)
+        backoff = chaos.Backoff(base=0.1, cap=2.0, max_attempts=total_rounds - 1)
+        while True:
+            err = await self._pull_to_node_once(oid, dest_nid)
+            if err is None or not err.startswith("ObjectLostError"):
+                return err
+            # a spill may have raced the pull (the holder deleted its shm
+            # copy and its SPILL_NOTIFY is in flight): give the notify a
+            # beat, then restore-and-retry before declaring the object lost
+            await asyncio.sleep(0.3)
+            if oid in self.object_spilled:
+                rerr = await self._restore_spilled(oid)
+                if rerr is None:
+                    if dest_nid in self.object_locations.get(oid, ()):
+                        return None
+                    err2 = await self._pull_to_node_once(oid, dest_nid)
+                    if err2 is None:
+                        return None
+                    err = err2
+            if "no live copy" in err:
+                return err
+            delay = backoff.next_delay()
+            if delay is None:
+                return err
+            logger.info(
+                "pull of %s to %s failed (%s); retrying in %.2fs "
+                "(round %d/%d)",
+                oid.hex()[:16],
+                dest_nid.hex()[:8],
+                err,
+                delay,
+                backoff.attempt + 1,
+                total_rounds,
+            )
+            await asyncio.sleep(delay)
 
     async def _pull_to_node_once(self, oid: bytes, dest_nid: bytes) -> Optional[str]:
         last_err = "no live copy"
@@ -2097,6 +2142,37 @@ class HeadServer:
                 out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
         return {"tasks": out, "finished": self.finished_task_count}
 
+    def _chaos_emit(self, ev: dict):
+        self._record_event("WARNING", "chaos", ev["message"], **ev["fields"])
+
+    async def h_chaos_ctrl(self, cid, conn, p):
+        """Runtime chaos arm/disarm from the driver, applied here and
+        fanned out: live chaos-aware processes get the push on the
+        "chaos" pubsub channel; late joiners read the KV entry at
+        startup.  Runtime-armed plans are deliberately NOT WAL-persisted
+        — a restarted head comes back fault-free unless env re-arms it."""
+        import json as _json
+
+        op = str(p.get("op", ""))
+        if op == "arm":
+            plan, seed = str(p.get("plan", "")), int(p.get("seed", 0))
+            ctrl = {"op": "arm", "plan": plan, "seed": seed}
+            chaos.apply_ctrl(ctrl)
+            self.kv["chaos:plan"] = _json.dumps(ctrl).encode()
+            self._record_event("WARNING", "chaos", f"chaos armed: {plan}", seed=seed)
+        elif op == "disarm":
+            chaos.apply_ctrl({"op": "disarm"})
+            self.kv.pop("chaos:plan", None)
+            self._record_event("INFO", "chaos", "chaos disarmed")
+        elif op != "status":
+            raise ValueError(f"unknown chaos op {op!r}")
+        if op != "status":
+            await self._publish(
+                "chaos",
+                {"op": op, "plan": str(p.get("plan", "")), "seed": int(p.get("seed", 0))},
+            )
+        return {"ok": True, "status": chaos.status()}
+
     def _record_event(self, severity: str, source: str, message: str, **fields):
         self.events.append(
             {
@@ -2431,6 +2507,9 @@ class HeadServer:
         env["RAY_TPU_HEAD"] = f"{self.host}:{self.port}"
         env["RAY_TPU_NODE_ID"] = node.node_id.hex()
         env["RAY_TPU_STORE_PATH"] = node.store_path
+        # per-process chaos stream id: worker k's fault decisions come from
+        # a distinct deterministic RNG stream (chaos.py stream_seed)
+        env["RAY_TPU_CHAOS_NONCE"] = str(self._next_worker_seq)
         if tpu:
             # TPU worker: keep the ambient claim env (axon sitecustomize runs
             # at interpreter start and needs it) — this worker owns the chips
@@ -2614,6 +2693,7 @@ HeadServer._HANDLERS = {
     MsgType.LIST_OBJECTS: HeadServer.h_list_objects,
     MsgType.LIST_EVENTS: HeadServer.h_list_events,
     MsgType.RECORD_EVENT: HeadServer.h_record_event,
+    MsgType.CHAOS_CTRL: HeadServer.h_chaos_ctrl,
     MsgType.SUBMIT_TASKS: HeadServer.h_submit_tasks,
     MsgType.CLIENT_PUT: HeadServer.h_client_put,
     MsgType.CLIENT_GET: HeadServer.h_client_get,

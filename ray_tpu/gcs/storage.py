@@ -16,6 +16,7 @@ ones possible (VERDICT r3 weak #8).
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import pickle
@@ -23,6 +24,8 @@ import struct
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import chaos
 
 logger = logging.getLogger(__name__)
 
@@ -102,6 +105,31 @@ class GcsWalStorage:
     def append(self, record: Tuple):
         payload = pickle.dumps(record, protocol=5)
         f = self._open()
+        if chaos.disk_on:
+            verdict = chaos.disk_decide("disk.wal.append")
+            if verdict is not None:
+                action, param = verdict
+                if action == "delay":
+                    time.sleep(param)  # slow-disk injection (sync path)
+                elif action == "short":
+                    # torn write: header + half the payload reach the disk
+                    # (flushed — a kill inside this window leaves a genuine
+                    # torn tail for replay's crc check), then the tear is
+                    # truncated away before raising.  A SURVIVING process
+                    # must not keep appending after torn bytes: replay stops
+                    # at the first bad crc, so a mid-file tear would
+                    # silently drop every later acknowledged record.
+                    start = f.tell()
+                    f.write(self._HDR.pack(len(payload), zlib.crc32(payload)))
+                    f.write(payload[: len(payload) // 2])
+                    f.flush()
+                    f.truncate(start)
+                    f.seek(start)
+                    raise OSError(
+                        errno.ENOSPC, "chaos: short WAL append (torn tail)"
+                    )
+                elif action == "fail":
+                    raise OSError(errno.ENOSPC, "chaos: WAL append failed")
         f.write(self._HDR.pack(len(payload), zlib.crc32(payload)))
         f.write(payload)
         f.flush()
@@ -115,6 +143,21 @@ class GcsWalStorage:
         mid-fsync re-arms it (clearing after would mark that append
         durable without ever syncing it)."""
         if self._f is not None and self._fsync_pending:
+            if chaos.disk_on:
+                verdict = chaos.disk_decide("disk.wal.fsync")
+                if verdict is not None:
+                    action, param = verdict
+                    if action == "delay":
+                        time.sleep(param)  # slow fsync (runs off-loop)
+                    elif action == "skip":
+                        # silent durability hole: appends stay OS-buffered.
+                        # _fsync_pending stays set so a later healthy sync
+                        # still covers them.
+                        return
+                    elif action == "fail":
+                        # before the flag clears: the owner's retry on the
+                        # next tick re-attempts these appends
+                        raise OSError(errno.EIO, "chaos: WAL fsync failed")
             self._fsync_pending = False
             os.fsync(self._f.fileno())
             self._last_fsync = time.monotonic()

@@ -26,6 +26,7 @@ import asyncio
 import struct
 from typing import Dict, Optional
 
+from ray_tpu._private.chaos import Backoff
 from ray_tpu.core.shm_store import ShmObjectStore
 
 _HDR = struct.Struct("<BQ")
@@ -108,7 +109,20 @@ class ObjectTransferAgent:
 
     async def _pull_once(self, oid: bytes, src_addr: str) -> bool:
         host, port = src_addr.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
+        # bounded full-jitter dial retry (3 retries after the first dial):
+        # a peer agent mid-restart answers a beat later; without this every
+        # refused dial escalates to a full head-level pull round (or
+        # lineage reconstruction)
+        backoff = Backoff(base=0.05, cap=0.5, max_attempts=3)
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(host, int(port))
+                break
+            except OSError:
+                delay = backoff.next_delay()
+                if delay is None:
+                    raise
+                await asyncio.sleep(delay)
         try:
             writer.write(oid)
             await writer.drain()

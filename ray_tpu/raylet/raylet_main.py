@@ -22,6 +22,7 @@ import threading
 import traceback
 from typing import List
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.protocol import Connection, MsgType
@@ -116,6 +117,7 @@ class Raylet:
             print(f"raylet: metrics endpoint unavailable: {e}", file=sys.stderr)
             metrics_port = 0
 
+        chaos.maybe_init_from_env("raylet")
         conn = await Connection.connect(self.head_host, self.head_port)
         self.conn = conn
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
@@ -157,6 +159,38 @@ class Raylet:
             pattern=f"worker-{self.node_id.hex()[:8]}-*.log",
         )
         self._log_tailer.start()
+
+        if chaos.aware():
+            # fault events → the head's cluster-event ring (best-effort;
+            # RECORD_EVENT frames are exempt from injection)
+            def _chaos_emit(ev: dict):
+                asyncio.run_coroutine_threadsafe(
+                    conn.send(
+                        MsgType.RECORD_EVENT,
+                        {
+                            "severity": "WARNING",
+                            "source": "chaos",
+                            "message": ev["message"],
+                            "fields": ev["fields"],
+                        },
+                    ),
+                    loop,
+                )
+
+            chaos.set_emitter(_chaos_emit)
+            # late-joiner plan sync + live arm/disarm pushes (the PUBLISH
+            # branch in _read_loop applies them)
+            try:
+                kv = await conn.request(MsgType.KV_GET, {"key": "chaos:plan"}, 10)
+                if kv.get("found"):
+                    chaos.apply_ctrl(json.loads(bytes(kv["value"]).decode()))
+                await conn.request(MsgType.SUBSCRIBE, {"channel": "chaos"}, 10)
+            except Exception:  # noqa: BLE001
+                print(
+                    "raylet: chaos control-channel sync failed; env-armed "
+                    "plan (if any) stays active",
+                    file=sys.stderr,
+                )
         print(f"NODE {self.node_id.hex()}", flush=True)
         await reply_fut
 
@@ -200,6 +234,11 @@ class Raylet:
                     asyncio.get_running_loop().create_task(
                         self._handle_restore(conn, rid, payload)
                     )
+                elif (
+                    msg_type == MsgType.PUBLISH
+                    and payload.get("channel") == "chaos"
+                ):
+                    chaos.apply_ctrl(payload.get("message") or {})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -247,6 +286,8 @@ class Raylet:
         env["RAY_TPU_HEAD"] = f"{self.head_host}:{self.head_port}"
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_STORE_PATH"] = self.store_path
+        # per-process chaos stream id (see chaos.py stream_seed)
+        env["RAY_TPU_CHAOS_NONCE"] = str(self._worker_seq)
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
             env.pop("JAX_PLATFORMS", None)

@@ -10,9 +10,13 @@ it, after which gets and transfers proceed as if it never left.
 
 from __future__ import annotations
 
+import errno
 import os
 import sys
+import time
 from typing import Optional
+
+from ray_tpu._private import chaos
 
 
 def spill_path(spill_dir: str, oid: bytes) -> str:
@@ -30,6 +34,20 @@ def spill_object(store, oid: bytes, spill_dir: str) -> Optional[str]:
     path = spill_path(spill_dir, oid)
     tmp = path + ".tmp"
     try:
+        if chaos.disk_on:
+            verdict = chaos.disk_decide("disk.spill.write")
+            if verdict is not None:
+                action, param = verdict
+                if action == "delay":
+                    time.sleep(param)  # slow spill disk (off-loop path)
+                elif action == "short":
+                    # torn spill file must never become the final path
+                    with open(tmp, "wb") as f:
+                        f.write(bytes(view[: max(1, len(view) // 2)]))
+                    delete_spilled(tmp)
+                    raise OSError(errno.ENOSPC, "chaos: short spill write")
+                elif action == "fail":
+                    raise OSError(errno.ENOSPC, "chaos: spill write failed")
         with open(tmp, "wb") as f:
             f.write(view)
         os.replace(tmp, path)
@@ -55,6 +73,14 @@ def restore_object(store, oid: bytes, path: str) -> bool:
     if buf is None:  # concurrent restore won the race
         return store.contains(oid)
     try:
+        if chaos.disk_on:
+            verdict = chaos.disk_decide("disk.spill.read")
+            if verdict is not None:
+                action, param = verdict
+                if action == "delay":
+                    time.sleep(param)  # slow restore (executor thread)
+                elif action == "fail":
+                    raise IOError("chaos: spill read failed")
         with open(path, "rb") as f:
             remaining = memoryview(buf)
             while remaining.nbytes:

@@ -1,0 +1,217 @@
+"""Test-facing chaos orchestration: arm/disarm plans cluster-wide and
+drive process-plane faults (kill / SIGSTOP-stall) against live actors,
+workers, and nodes.
+
+This is the layer ``tests/test_chaos.py`` scripts against.  The
+injection substrate itself lives in :mod:`ray_tpu._private.chaos` (plan
+grammar, determinism contract: ``ray_tpu/_private/CHAOS.md``).
+
+Runtime arm/disarm rides ``MsgType.CHAOS_CTRL`` to the head, which arms
+its own process, stores the plan in KV ``chaos:plan`` for late-joining
+processes, and fans out to every chaos-aware process over the ``chaos``
+pubsub channel.  Processes are chaos-aware when ``RAY_TPU_CHAOS_ENABLE``
+(or a ``RAY_TPU_CHAOS_PLAN``) was in their environment at start — the
+default cluster pays nothing for any of this.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import List, Optional
+
+from ray_tpu._private import chaos
+from ray_tpu._private.protocol import MsgType
+
+Backoff = chaos.Backoff  # re-export: the one retry discipline
+
+
+def _core_worker():
+    from ray_tpu._private.worker import global_worker
+
+    if not global_worker.connected:
+        return None
+    return global_worker.core_worker
+
+
+def arm(plan: str, seed: int = 0) -> dict:
+    """Arm a fault plan cluster-wide (and locally).  Returns the head's
+    chaos status.  Without a connected driver this arms only the local
+    process (unit-test mode)."""
+    cw = _core_worker()
+    chaos.arm(plan, seed)
+    if cw is None:
+        return chaos.status()
+    reply = cw.request(MsgType.CHAOS_CTRL, {"op": "arm", "plan": plan, "seed": seed})
+    return reply.get("status", {})
+
+
+def disarm() -> dict:
+    """Disarm cluster-wide (and locally)."""
+    cw = _core_worker()
+    chaos.disarm()
+    if cw is None:
+        return chaos.status()
+    reply = cw.request(MsgType.CHAOS_CTRL, {"op": "disarm"})
+    return reply.get("status", {})
+
+
+def status() -> dict:
+    """The head's chaos status (plan, seed, fired count)."""
+    cw = _core_worker()
+    if cw is None:
+        return chaos.status()
+    return cw.request(MsgType.CHAOS_CTRL, {"op": "status"}).get("status", {})
+
+
+def local_fired() -> List[dict]:
+    """This process's fired-fault log — the determinism witness."""
+    return chaos.fired()
+
+
+def fault_events(limit: int = 1000) -> List[dict]:
+    """Chaos entries from the head's cluster-event ring (every fired
+    fault and every process-plane strike emits one, best-effort when the
+    fault kills its own reporting channel)."""
+    cw = _core_worker()
+    if cw is None:
+        return []
+    events = cw.request(MsgType.LIST_EVENTS, {"limit": limit}).get("events", [])
+    return [e for e in events if e.get("source") == "chaos"]
+
+
+# ------------------------------------------------------------- process plane
+
+
+def _actor_pid(actor) -> int:
+    """Resolve the pid of the worker hosting `actor` via the head's actor
+    directory (h_list_actors carries the hosting worker's pid)."""
+    cw = _core_worker()
+    if cw is None:
+        raise RuntimeError("chaos_api needs a connected driver (ray_tpu.init)")
+    actor_id = actor if isinstance(actor, bytes) else actor._actor_id
+    for a in cw.request(MsgType.LIST_ACTORS, {}).get("actors", []):
+        if bytes(a["actor_id"]) == actor_id:
+            pid = int(a.get("pid") or 0)
+            if pid:
+                return pid
+            raise RuntimeError(
+                f"actor {actor_id.hex()[:8]} has no live worker "
+                f"(state={a.get('state')})"
+            )
+    raise RuntimeError(f"actor {actor_id.hex()[:8]} not found")
+
+
+def _strike_event(message: str, **fields):
+    cw = _core_worker()
+    if cw is None:
+        return
+    try:
+        cw.request(
+            MsgType.RECORD_EVENT,
+            {
+                "severity": "WARNING",
+                "source": "chaos",
+                "message": message,
+                "fields": fields,
+            },
+        )
+    except Exception:  # graftlint: disable=silent-except -- strike bookkeeping is best-effort; the strike itself already landed
+        pass
+
+
+def kill_worker(actor=None, pid: Optional[int] = None, sig: int = signal.SIGKILL) -> int:
+    """SIGKILL the worker process hosting `actor` (or an explicit pid) —
+    the crash the actor FSM / task retry must absorb.  Returns the pid
+    struck."""
+    if pid is None:
+        pid = _actor_pid(actor)
+    chaos.kill_process(pid, sig)
+    _strike_event("chaos kill_worker", pid=pid, sig=int(sig))
+    return pid
+
+
+def suspend_worker(actor=None, pid: Optional[int] = None) -> int:
+    """SIGSTOP the worker hosting `actor`: sockets stay open, heartbeats
+    stop — the wedged-but-connected shape missed-beat expiry catches."""
+    if pid is None:
+        pid = _actor_pid(actor)
+    chaos.suspend_process(pid)
+    _strike_event("chaos suspend_worker", pid=pid)
+    return pid
+
+
+def resume_worker(pid: int) -> None:
+    chaos.resume_process(pid)
+    _strike_event("chaos resume_worker", pid=pid)
+
+
+def kill_node(node) -> None:
+    """SIGKILL a raylet (a ``cluster_utils.NodeHandle`` or a raw pid).
+    Its store segment, workers, and object copies die with it."""
+    if hasattr(node, "proc"):
+        pid = node.proc.pid
+        node.kill(force=True)
+    else:
+        pid = int(node)
+        chaos.kill_process(pid)
+    _strike_event("chaos kill_node", pid=pid)
+
+
+def kill_head(cluster) -> None:
+    """SIGKILL the head of a ``cluster_utils.Cluster`` (no graceful WAL
+    compaction — recovery must come from base+WAL replay)."""
+    cluster.kill_head(force=True)
+
+
+def wait_actor_respawn(actor, old_pid: int, timeout: float = 60.0) -> int:
+    """Wait until `actor` is ALIVE on a worker OTHER than `old_pid` and
+    return the new pid.  Plain wait-for-ALIVE races the head noticing the
+    death (the directory still says ALIVE on the struck worker for a
+    beat) — respawn is only proven by a fresh pid."""
+    cw = _core_worker()
+    if cw is None:
+        raise RuntimeError("chaos_api needs a connected driver (ray_tpu.init)")
+    actor_id = actor if isinstance(actor, bytes) else actor._actor_id
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        state = cw.request(MsgType.ACTOR_STATE, {"actor_id": actor_id}).get("state")
+        if state == "ALIVE":
+            try:
+                pid = _actor_pid(actor_id)
+            except RuntimeError:
+                pid = 0
+            if pid and pid != old_pid:
+                return pid
+        elif state == "DEAD":
+            raise RuntimeError(
+                f"actor {actor_id.hex()[:8]} died terminally instead of respawning"
+            )
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"actor {actor_id.hex()[:8]} did not respawn off pid {old_pid} "
+        f"within {timeout:.0f}s"
+    )
+
+
+def wait_actor_state(actor, state: str, timeout: float = 60.0) -> str:
+    """Poll the head's actor FSM until `actor` reaches `state` (e.g.
+    "ALIVE" after a chaos kill).  Returns the final state; raises
+    TimeoutError if never reached."""
+    cw = _core_worker()
+    if cw is None:
+        raise RuntimeError("chaos_api needs a connected driver (ray_tpu.init)")
+    actor_id = actor if isinstance(actor, bytes) else actor._actor_id
+    deadline = time.monotonic() + timeout
+    last = "UNKNOWN"
+    while time.monotonic() < deadline:
+        last = cw.request(MsgType.ACTOR_STATE, {"actor_id": actor_id}).get(
+            "state", "UNKNOWN"
+        )
+        if last == state:
+            return last
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"actor {actor_id.hex()[:8]} never reached {state} "
+        f"within {timeout:.0f}s (last state: {last})"
+    )

@@ -1,0 +1,781 @@
+"""Deterministic chaos layer: fault injection across the wire / process /
+disk planes, backoff-disciplined recovery, and the determinism contract
+(same seed + plan ⇒ same per-stream fault sequence).
+
+Reference tier: python/ray/tests/test_chaos.py + chaos_utils.py
+(NodeKiller/WorkerKiller) — here driven through the seed-deterministic
+injection substrate in ray_tpu/_private/chaos.py instead of ad-hoc
+random killers, so every failure a test provokes is reproducible.
+
+Run with: pytest -m chaos  (the CI `chaos` job).  Tests not marked
+`slow` also ride tier-1.
+"""
+
+import asyncio
+import json
+import os
+import random
+import signal
+import socket
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.protocol import Connection, MsgType
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """Every test leaves the process chaos-free."""
+    yield
+    chaos.disarm()
+    chaos.set_emitter(None)
+    chaos.set_scope("driver", 0)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ===================================================================== plan
+
+
+def test_plan_parsing_roundtrip():
+    rules = chaos.parse_plan(
+        "worker:wire.send.sever@TASK_DONE#1=1.0; disk.wal.fsync.fail=0.5,"
+        "wire.read.delay@HEARTBEAT=0.25:0.1"
+    )
+    assert [(r.point, r.action) for r in rules] == [
+        ("wire.send", "sever"),
+        ("disk.wal.fsync", "fail"),
+        ("wire.read", "delay"),
+    ]
+    sever, fsync, delay = rules
+    assert (sever.role, sever.msg_filter, sever.max_fires, sever.rate) == (
+        "worker",
+        "TASK_DONE",
+        1,
+        1.0,
+    )
+    assert (fsync.role, fsync.msg_filter, fsync.max_fires) == (None, None, None)
+    assert (delay.rate, delay.param) == (0.25, 0.1)
+
+
+def test_plan_parsing_rejects_malformed():
+    for bad in (
+        "wire.send.drop",  # no rate
+        "wire.send.explode=1.0",  # unknown action
+        "disk.wal.append.fail@HEARTBEAT=1.0",  # filter on a disk point
+        "wire.send.drop=1.5",  # rate out of range
+        "cook:wire.send.drop=1.0",  # unknown role
+        "nosuch.point.drop=1.0",  # unknown point
+    ):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
+
+def test_point_catalog_matches_doc():
+    """CHAOS.md is the operator-facing contract: every injection point and
+    action the code supports must be documented there."""
+    doc_path = os.path.join(
+        os.path.dirname(ray_tpu.__file__), "_private", "CHAOS.md"
+    )
+    with open(doc_path) as f:
+        doc = f.read()
+    for point, actions in chaos.point_catalog().items():
+        for action in actions:
+            assert f"{point}.{action}" in doc, (
+                f"injection point {point}.{action} is undocumented in CHAOS.md"
+            )
+
+
+# ================================================================== backoff
+
+
+def test_backoff_deterministic_schedule():
+    a = chaos.Backoff(base=0.05, cap=2.0, max_attempts=8, rng=random.Random(7))
+    b = chaos.Backoff(base=0.05, cap=2.0, max_attempts=8, rng=random.Random(7))
+    sched_a = [a.next_delay() for _ in range(10)]
+    sched_b = [b.next_delay() for _ in range(10)]
+    assert sched_a == sched_b
+    assert sched_a[8] is None and sched_a[9] is None  # budget exhausted
+    c = chaos.Backoff(base=0.05, cap=2.0, max_attempts=8, rng=random.Random(8))
+    assert [c.next_delay() for _ in range(8)] != sched_a[:8]
+
+
+def test_backoff_full_jitter_bounds():
+    bo = chaos.Backoff(base=0.1, factor=2.0, cap=1.0, max_attempts=64, rng=random.Random(3))
+    for attempt in range(64):
+        d = bo.next_delay()
+        assert d is not None
+        assert 0.0 <= d <= min(1.0, 0.1 * 2.0**attempt)
+
+
+def test_backoff_deadline_bound():
+    bo = chaos.Backoff(base=10.0, cap=10.0, deadline_s=0.2)
+    d = bo.next_delay()
+    assert d is not None and d <= 0.2  # clipped to the deadline window
+    time.sleep(0.25)
+    assert bo.next_delay() is None  # deadline passed: budget gone
+
+
+# ============================================================== determinism
+
+
+def test_deterministic_fault_sequence_same_seed():
+    """Same (seed, scope, plan) + same op sequence ⇒ identical verdicts;
+    a different seed diverges.  This is the core determinism contract."""
+
+    def run(seed):
+        ctl = chaos.ChaosController(
+            "wire.send.drop=0.5;wire.read.delay@HEARTBEAT=0.3", seed, "worker", 1
+        )
+        verdicts = []
+        for i in range(64):
+            verdicts.append(ctl.decide("wire.send", int(MsgType.KV_PUT)))
+            verdicts.append(ctl.decide("wire.read", int(MsgType.HEARTBEAT)))
+        return verdicts, [
+            (f["seq"], f["point"], f["action"], f["msg_type"]) for f in ctl.fired()
+        ]
+
+    v1, log1 = run(11)
+    v2, log2 = run(11)
+    assert v1 == v2 and log1 == log2
+    assert any(v is not None for v in v1)  # the plan actually fires
+    v3, _ = run(12)
+    assert v3 != v1
+
+
+def test_stream_isolation_across_scopes():
+    """Different process scopes (worker nonces) draw from independent RNG
+    streams — the lever e2e tests use to make worker 1 fail and worker 2
+    succeed, deterministically."""
+    draws = {
+        nonce: chaos.stream_rng(5, "worker", nonce, "wire.send", "sever", "TASK_DONE").random()
+        for nonce in (1, 2, 3)
+    }
+    assert len(set(draws.values())) == 3
+    # and the same scope re-derives the same stream
+    again = chaos.stream_rng(5, "worker", 1, "wire.send", "sever", "TASK_DONE").random()
+    assert again == draws[1]
+
+
+def test_rate_bounds_and_max_fires():
+    ctl = chaos.ChaosController("wire.send.drop=0.0", 1, "driver", 0)
+    assert all(ctl.decide("wire.send", 50) is None for _ in range(50))
+    ctl = chaos.ChaosController("wire.send.drop=1.0", 1, "driver", 0)
+    assert all(ctl.decide("wire.send", 50) is not None for _ in range(50))
+    ctl = chaos.ChaosController("wire.send.drop#3=1.0", 1, "driver", 0)
+    fired = [ctl.decide("wire.send", 50) for _ in range(10)]
+    assert sum(v is not None for v in fired) == 3  # capped
+
+
+def test_role_scoping_drops_foreign_rules():
+    chaos.set_scope("driver", 0)
+    chaos.arm("worker:wire.send.drop=1.0", seed=1)
+    # worker-role rule never arms the driver's wire plane
+    assert not chaos.wire_on
+    assert chaos.wire_decide("wire.send", int(MsgType.KV_PUT)) is None
+    chaos.disarm()
+    chaos.set_scope("worker", 1)
+    chaos.arm("worker:wire.send.drop=1.0", seed=1)
+    assert chaos.wire_on
+    assert chaos.wire_decide("wire.send", int(MsgType.KV_PUT)) is not None
+
+
+def test_rearm_same_plan_is_idempotent():
+    """The cluster arm path echoes the plan back to the driver over
+    pubsub; the echo must not reset fire budgets, RNG streams, or the
+    fired() log (a #1 rule would otherwise fire twice)."""
+    chaos.arm("wire.send.drop#1=1.0", seed=9)
+    assert chaos.wire_decide("wire.send", 50) is not None  # budget spent
+    chaos.arm("wire.send.drop#1=1.0", seed=9)  # echo: must be a no-op
+    assert chaos.wire_decide("wire.send", 50) is None
+    assert len(chaos.fired()) == 1
+    chaos.arm("wire.send.drop#1=1.0", seed=10)  # different seed: fresh arm
+    assert chaos.wire_decide("wire.send", 50) is not None
+
+
+def test_disabled_is_noop():
+    """Default state: no controller, flags down, verdicts None — the
+    injection points reduce to one module-attribute check."""
+    assert not chaos.armed()
+    assert not chaos.wire_on and not chaos.disk_on
+    assert chaos.wire_decide("wire.send", int(MsgType.KV_PUT)) is None
+    assert chaos.disk_decide("disk.wal.fsync") is None
+    assert chaos.fired() == []
+
+
+# ===================================================================== wire
+
+
+class _Loopback:
+    """A tiny frame-collecting server + client Connection pair, for
+    exercising the real Connection injection points in-process."""
+
+    def __init__(self):
+        self.received = []
+        self.server = None
+        self.conn = None
+
+    async def __aenter__(self):
+        async def serve(reader, writer):
+            server_conn = Connection(reader, writer)
+            try:
+                while True:
+                    self.received.append(await server_conn.read_frame())
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                pass
+
+        self.server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = self.server.sockets[0].getsockname()[1]
+        self.conn = await Connection.connect("127.0.0.1", port, timeout=5)
+        return self
+
+    async def __aexit__(self, *exc):
+        self.conn.close()
+        self.server.close()
+
+    async def drain(self, expected, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while len(self.received) < expected and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+
+
+def test_wire_drop_filtered_by_msgtype():
+    async def main():
+        chaos.arm("wire.send.drop@HEARTBEAT=1.0", seed=1)
+        async with _Loopback() as lb:
+            for _ in range(5):
+                await lb.conn.send(MsgType.HEARTBEAT, {})
+            for i in range(3):
+                await lb.conn.send(MsgType.KV_PUT, {"i": i})
+            await lb.drain(3)
+        kinds = [f[0] for f in lb.received]
+        assert kinds == [int(MsgType.KV_PUT)] * 3  # heartbeats vanished
+        assert len(chaos.fired()) == 5
+
+    asyncio.run(main())
+
+
+def test_wire_dup_and_delay():
+    async def main():
+        chaos.arm(
+            "wire.send.dup@KV_PUT=1.0;wire.send.delay@KV_GET=1.0:0.2", seed=1
+        )
+        async with _Loopback() as lb:
+            await lb.conn.send(MsgType.KV_PUT, {"k": 1})
+            t0 = time.monotonic()
+            await lb.conn.send(MsgType.KV_GET, {"k": 1})
+            delayed = time.monotonic() - t0
+            await lb.drain(3)
+        assert delayed >= 0.2
+        kinds = [f[0] for f in lb.received]
+        assert kinds == [int(MsgType.KV_PUT)] * 2 + [int(MsgType.KV_GET)]
+
+    asyncio.run(main())
+
+
+def test_wire_read_drop_is_receiver_side():
+    async def main():
+        chaos.arm("wire.read.drop@HEARTBEAT=1.0", seed=1)
+        async with _Loopback() as lb:
+            await lb.conn.send(MsgType.HEARTBEAT, {})  # sent, dropped on read
+            await lb.conn.send(MsgType.KV_PUT, {})
+            await lb.drain(1)
+        assert [f[0] for f in lb.received] == [int(MsgType.KV_PUT)]
+
+    asyncio.run(main())
+
+
+def test_wire_sever_closes_connection():
+    async def main():
+        chaos.arm("wire.send.sever@KV_PUT#1=1.0", seed=1)
+        async with _Loopback() as lb:
+            await lb.conn.send(MsgType.HEARTBEAT, {})  # unfiltered: passes
+            with pytest.raises(ConnectionError):
+                await lb.conn.send(MsgType.KV_PUT, {})
+            assert lb.conn.closed
+
+    asyncio.run(main())
+
+
+def test_record_event_frames_are_exempt():
+    """The observability channel must survive any plan — fault reports
+    ride RECORD_EVENT through the very wire being faulted."""
+    async def main():
+        chaos.arm("wire.send.drop=1.0", seed=1)  # drop EVERYTHING unfiltered
+        async with _Loopback() as lb:
+            await lb.conn.send(MsgType.KV_PUT, {})  # dropped
+            await lb.conn.send(MsgType.RECORD_EVENT, {"message": "x"})  # exempt
+            await lb.drain(1)
+        assert [f[0] for f in lb.received] == [int(MsgType.RECORD_EVENT)]
+
+    asyncio.run(main())
+
+
+def test_two_runs_same_seed_identical_fault_sequence():
+    """Acceptance: two runs with the same RAY_TPU_CHAOS_SEED produce
+    identical fault-event sequences (same ops through real Connections)."""
+
+    async def run_once(seed):
+        chaos.set_scope("driver", 0)
+        chaos.arm("wire.send.drop@HEARTBEAT=0.5", seed=seed)
+        async with _Loopback() as lb:
+            for _ in range(40):
+                await lb.conn.send(MsgType.HEARTBEAT, {})
+            await lb.conn.send(MsgType.KV_PUT, {})  # fence
+            # everything not dropped must arrive before we count
+            await lb.drain(41 - len(chaos.fired()))
+        log = [(f["seq"], f["point"], f["action"], f["msg_type"]) for f in chaos.fired()]
+        chaos.disarm()
+        return log, len(lb.received)
+
+    log1, n1 = asyncio.run(run_once(1234))
+    log2, n2 = asyncio.run(run_once(1234))
+    assert log1 == log2 and n1 == n2
+    assert 0 < len(log1) < 40  # rate 0.5 fired some, not all
+    log3, _ = asyncio.run(run_once(4321))
+    assert log3 != log1
+
+
+# ================================================== connect retry / typed err
+
+
+def test_connect_retries_until_listener_up():
+    """A peer that is mid-restart: the dial retries with backoff inside
+    the window instead of failing every client at t=0."""
+
+    async def main():
+        port = _free_port()
+        frames = []
+
+        async def start_late():
+            await asyncio.sleep(0.7)
+
+            async def serve(reader, writer):
+                server_conn = Connection(reader, writer)
+                try:
+                    while True:
+                        frames.append(await server_conn.read_frame())
+                except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                    pass
+
+            return await asyncio.start_server(serve, "127.0.0.1", port)
+
+        task = asyncio.create_task(start_late())
+        t0 = time.monotonic()
+        conn = await Connection.connect("127.0.0.1", port, timeout=10)
+        dial = time.monotonic() - t0
+        assert 0.5 <= dial < 9.0  # retried past the dead window, well inside budget
+        conn.close()
+        (await task).close()
+
+    asyncio.run(main())
+
+
+def test_connect_no_retry_fails_fast():
+    async def main():
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError)):
+            await Connection.connect("127.0.0.1", _free_port(), timeout=10, retry=False)
+        assert time.monotonic() - t0 < 2.0  # no dial-window burn
+
+    asyncio.run(main())
+
+
+def test_head_unreachable_error_is_typed():
+    from ray_tpu.core.core_worker import CoreWorker
+    from ray_tpu.exceptions import HeadUnreachableError
+
+    RayConfig.initialize({"connect_timeout_s": 1.0})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(HeadUnreachableError):
+            CoreWorker("127.0.0.1", _free_port(), mode="driver")
+        assert time.monotonic() - t0 < 8.0
+        # typed but still a ConnectionError: existing handlers keep working
+        assert issubclass(HeadUnreachableError, ConnectionError)
+    finally:
+        RayConfig.reset()
+
+
+# ===================================================================== disk
+
+
+def test_wal_append_short_write_torn_tail(tmp_path):
+    """A torn append (chaos short write) must not poison replay: every
+    record before the tear survives, the tear is dropped."""
+    from ray_tpu.gcs.storage import GcsWalStorage
+
+    st = GcsWalStorage(str(tmp_path))
+    st.append(("a", 1))
+    st.append(("b", 2))
+    chaos.arm("disk.wal.append.short#1=1.0", seed=1)
+    with pytest.raises(OSError):
+        st.append(("c", 3))
+    chaos.disarm()
+    st.sync()
+    tables, records = GcsWalStorage(str(tmp_path)).load()
+    assert records == [("a", 1), ("b", 2)]
+
+
+def test_wal_fsync_fault_rearms_pending_flag(tmp_path):
+    """An injected fsync failure leaves the batched-fsync flag SET, so the
+    owner's next tick retries and the appends eventually become durable."""
+    from ray_tpu.gcs.storage import GcsWalStorage
+
+    st = GcsWalStorage(str(tmp_path))
+    st.append(("a", 1))
+    chaos.arm("disk.wal.fsync.fail#1=1.0", seed=1)
+    with pytest.raises(OSError):
+        st.sync()
+    assert st._fsync_pending  # retried next tick
+    st.sync()  # fault budget (#1) spent: this one lands
+    assert not st._fsync_pending
+    _, records = GcsWalStorage(str(tmp_path)).load()
+    assert records == [("a", 1)]
+
+
+def test_spill_write_fault_keeps_object_in_store(tmp_path):
+    """ENOSPC mid-spill: the candidate is skipped, the shm copy stays, no
+    torn spill file becomes visible."""
+    from ray_tpu.core.shm_store import ShmObjectStore
+    from ray_tpu.raylet.spill import spill_batch
+
+    store = ShmObjectStore(str(tmp_path / "seg"), capacity=4 << 20, create=True)
+    try:
+        oid = b"o" * ShmObjectStore.ID_LEN
+        buf = store.raw_create(oid, 1 << 16)
+        buf[:] = b"x" * (1 << 16)
+        del buf
+        store.raw_seal(oid)
+        spill_dir = str(tmp_path / "spill")
+        chaos.arm("disk.spill.write.fail=1.0", seed=1)
+        assert spill_batch(store, 1 << 16, spill_dir) == {}
+        assert store.contains(oid)
+        assert not os.path.exists(os.path.join(spill_dir, oid.hex()))
+        chaos.disarm()
+        spilled = spill_batch(store, 1 << 16, spill_dir)
+        assert oid in spilled and os.path.exists(spilled[oid])
+    finally:
+        store.close()
+
+
+# ============================================================== e2e: planes
+
+
+@pytest.mark.slow
+def test_task_retry_under_wire_sever(tmp_path):
+    """Wire plane e2e: worker 1's TASK_DONE send severs its head
+    connection (deterministically, via its chaos stream); the head sees
+    the dead worker and retries the task on worker 2, whose stream says
+    pass.  The task runs exactly twice and the result survives."""
+    rate = 0.5
+
+    def severs(seed, nonce):
+        return (
+            chaos.stream_rng(seed, "worker", nonce, "wire.send", "sever", "TASK_DONE").random()
+            < rate
+        )
+
+    seed = next(s for s in range(10_000) if severs(s, 1) and not severs(s, 2))
+    marker = str(tmp_path / "runs")
+    try:
+        ray_tpu.init(
+            num_cpus=2,
+            _system_config={
+                "chaos_plan": f"worker:wire.send.sever@TASK_DONE={rate}",
+                "chaos_seed": seed,
+                "chaos_enable": True,
+            },
+        )
+
+        @ray_tpu.remote(max_retries=3)
+        def bump(x):
+            with open(marker, "a") as f:
+                f.write("x")
+            return x + 1
+
+        assert ray_tpu.get(bump.remote(41), timeout=120) == 42
+        assert os.path.getsize(marker) == 2  # first attempt + one retry
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_restart_under_chaos_kill(shutdown_only):
+    """Process plane e2e: chaos kills the actor's worker; the GCS FSM
+    restarts it (state reset), and the strike shows up in the cluster
+    event ring."""
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+    struck = chaos_api.kill_worker(c)
+    chaos_api.wait_actor_respawn(c, struck, timeout=60)
+    deadline = time.time() + 60
+    while True:
+        try:
+            v = ray_tpu.get(c.bump.remote(), timeout=30)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.3)
+    assert v == 1  # fresh incarnation: state reset
+    kills = [e for e in chaos_api.fault_events() if "kill_worker" in e["message"]]
+    assert kills and kills[-1]["pid"] == struck
+
+
+def test_actor_restart_exhaustion_reports_budget(shutdown_only):
+    """Drive an actor through max_restarts chaos kills: the terminal
+    RayActorError must carry the restart accounting (gcs/server.py actor
+    FSM exhaustion path)."""
+    from ray_tpu.exceptions import RayActorError
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=1)
+    class Frail:
+        def ping(self):
+            return "ok"
+
+    a = Frail.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    struck = chaos_api.kill_worker(a)  # restart 1/1
+    chaos_api.wait_actor_respawn(a, struck, timeout=60)
+    # strike the fresh incarnation: budget exhausted
+    chaos_api.kill_worker(a)
+    chaos_api.wait_actor_state(a, "DEAD", timeout=60)
+    with pytest.raises(RayActorError) as err:
+        ray_tpu.get(a.ping.remote(), timeout=60)
+    assert "restarts exhausted: 1/1" in str(err.value)
+
+
+def test_kill_actor_forbids_further_restarts(shutdown_only):
+    """ray.kill(no_restart=True) pins max_restarts to restarts_used: even
+    a generous budget must not resurrect an explicitly killed actor."""
+    from ray_tpu.exceptions import RayActorError
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=5)
+    class Immortal:
+        def ping(self):
+            return "ok"
+
+    a = Immortal.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    ray_tpu.kill(a)
+    time.sleep(1.0)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(a.ping.remote(), timeout=60)
+    from ray_tpu.util import chaos_api
+
+    with pytest.raises(TimeoutError):
+        chaos_api.wait_actor_state(a, "ALIVE", timeout=3)
+
+
+@pytest.mark.slow
+def test_wal_fsync_fault_head_recovers(monkeypatch):
+    """Disk plane e2e: the head runs with injected fsync failures on the
+    WAL (every fault logged in its event ring), is SIGKILLed, and the
+    restarted head still recovers state from base+WAL — appends were
+    flushed to the OS even when fsync lied."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import chaos_api
+
+    monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", "head:disk.wal.fsync.fail=0.5")
+    monkeypatch.setenv("RAY_TPU_CHAOS_SEED", "7")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        from ray_tpu._private.worker import global_worker
+
+        cw = global_worker.core_worker
+        for i in range(20):
+            cw.kv_put(f"chaos:test:{i}", str(i).encode())
+        deadline = time.time() + 30
+        fired = []
+        while time.time() < deadline:
+            fired = [
+                e for e in chaos_api.fault_events() if "disk.wal.fsync" in e["message"]
+            ]
+            if fired:
+                break
+            cw.kv_put("chaos:tick", b"x")  # keep WAL appends (and syncs) coming
+            time.sleep(0.5)
+        assert fired, "no fsync fault fired within 30s"
+
+        # runtime-arm the same plan: idempotent on the already-armed head
+        # (fire budgets survive) but lands "chaos:plan" in KV — which must
+        # NOT survive the restart below
+        st = chaos_api.arm("head:disk.wal.fsync.fail=0.5", seed=7)
+        assert st.get("fired", 0) >= 1  # idempotent: env-armed budget kept
+        assert cw.kv_get("chaos:plan") is not None
+
+        chaos_api.kill_head(c)
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        monkeypatch.delenv("RAY_TPU_CHAOS_PLAN")  # restarted head: fault-free
+        c.restart_head({"num_cpus": 2})
+        ray_tpu.init(address=c.address)
+        from ray_tpu._private.worker import global_worker as gw2
+
+        cw2 = gw2.core_worker
+        for i in range(20):
+            assert cw2.kv_get(f"chaos:test:{i}") == str(i).encode()
+        # a runtime/KV-held chaos plan must NOT survive the restart — the
+        # recovered head comes back fault-free (snapshot excludes it)
+        assert cw2.kv_get("chaos:plan") is None
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_replica_respawn_after_chaos_kill(shutdown_only):
+    """Serve e2e: chaos kills the replica's worker; the replica actor's
+    restart budget respawns it and the deployment serves again."""
+    from ray_tpu import serve
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(num_replicas=1, ray_actor_options={"max_restarts": 2})
+    class Echo:
+        def __call__(self, x):
+            return ("pong", x)
+
+    handle = serve.run(Echo.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=120) == ("pong", 1)
+
+    from ray_tpu._private.worker import global_worker
+
+    cw = global_worker.core_worker
+    replicas = [
+        a
+        for a in cw.request(MsgType.LIST_ACTORS, {}).get("actors", [])
+        if a["class_name"] == "Replica" and a["state"] == "ALIVE"
+    ]
+    assert replicas
+    chaos_api.kill_worker(pid=int(replicas[0]["pid"]))
+
+    deadline = time.time() + 90
+    while True:
+        try:
+            assert ray_tpu.get(handle.remote(2), timeout=20) == ("pong", 2)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+@pytest.mark.slow
+def test_reconstruction_after_chaos_node_kill(tmp_path):
+    """Object plane e2e: the only copy of a task output lives on a node
+    chaos kills; lineage re-executes the producer on a replacement node."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import chaos_api
+
+    marker = str(tmp_path / "runs")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        node = c.add_node(num_cpus=2, resources={"side": 2.0})
+
+        @ray_tpu.remote(resources={"side": 1.0}, max_retries=2)
+        def produce():
+            with open(marker, "a") as f:
+                f.write("x")
+            return np.full(200_000, 9.0)
+
+        ref = produce.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=120)
+        assert ready and os.path.getsize(marker) == 1
+
+        chaos_api.kill_node(node)  # the only copy dies with the node store
+        c.add_node(num_cpus=2, resources={"side": 2.0})
+        val = ray_tpu.get(ref, timeout=180)
+        assert val[0] == 9.0 and val.shape == (200_000,)
+        assert os.path.getsize(marker) == 2  # really re-executed
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_shutdown_reaps_suspended_head():
+    """A SIGSTOPped (wedged) head ignores SIGTERM: driver shutdown must
+    escalate to SIGKILL and reap — no zombie outlives the driver."""
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=1)
+    proc = global_worker.head_proc
+    assert proc is not None
+    chaos.suspend_process(proc.pid)
+    try:
+        t0 = time.monotonic()
+        ray_tpu.shutdown()
+        assert time.monotonic() - t0 < 30
+        assert proc.poll() is not None  # reaped, not a zombie
+        assert global_worker.head_proc is None
+    finally:
+        chaos.resume_process(proc.pid)  # no-op once killed
+
+
+def test_suspended_worker_declared_dead_by_heartbeat(shutdown_only):
+    """SIGSTOP stall (process plane): the actor's worker keeps its socket
+    open but goes silent; missed-beat expiry declares it dead and the FSM
+    restarts the actor."""
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={"heartbeat_period_ms": 100, "num_heartbeats_timeout": 15},
+    )
+
+    @ray_tpu.remote(max_restarts=1)
+    class Sleepy:
+        def ping(self):
+            return os.getpid()
+
+    a = Sleepy.remote()
+    pid1 = ray_tpu.get(a.ping.remote(), timeout=60)
+    chaos_api.suspend_worker(a)
+    try:
+        chaos_api.wait_actor_respawn(a, pid1, timeout=60)  # via missed beats
+        deadline = time.time() + 60
+        while True:
+            try:
+                pid2 = ray_tpu.get(a.ping.remote(), timeout=20)
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+        assert pid2 != pid1  # fresh worker hosts the restarted actor
+    finally:
+        chaos_api.resume_worker(pid1)
