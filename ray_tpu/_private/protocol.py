@@ -112,6 +112,7 @@ class MsgType(enum.IntEnum):
     LIST_OBJECTS = 76
     LIST_EVENTS = 77
     RECORD_EVENT = 78  # any process → head: append to the cluster-event ring
+    TASK_SUMMARY = 79  # per-phase latency summary over the flight records
 
     # errors pushed to driver
     ERROR_PUSH = 80  # graftlint: disable=protocol-exhaustive -- reserved; task errors reach drivers as stored RayTaskError values, not pushed frames

@@ -1,0 +1,128 @@
+"""Task-lifecycle flight recorder: per-phase timestamps from submit to done.
+
+Analog of the reference's task-event pipeline (reference:
+src/ray/core_worker/task_event_buffer.cc — per-attempt state-transition
+timestamps flushed to the GCS task manager and joined into
+`ray list tasks --detail` / the timeline; and the dispatch-latency focus
+of Pathways' single-controller tracing, PAPERS.md §2).
+
+A task's life is stamped at every hop it takes through the system:
+
+    driver            head                 worker
+    ------            ----                 ------
+    submit       →    head_enqueue    →    worker_dequeue
+                      dispatch             arg_fetch_start / arg_fetch_end
+                                           exec_start / exec_end
+                                           put_start / put_end
+    (result)     ←    done            ←    (TASK_DONE carries the stamps)
+
+The stamps ride the TaskSpec wire dict (``phases``) to the worker and come
+back on the TASK_DONE frame; the head joins them into one flight record
+per task and aggregates per-phase histograms (queue-wait, arg-fetch, exec,
+put, e2e).  Timestamps are ``time.time()``.  Clock caveat: queue_wait,
+arg_fetch, exec, and put are computed between stamps taken by ONE process,
+so they are immune to clock skew; ``deliver`` (head → worker) and ``e2e`` (driver →
+head) cross processes — exact on one host (shared wall clock), off by the
+NTP skew on multi-node clusters (and clamped at 0, never negative).
+
+Overhead contract: when recording is off (``RAY_TPU_TASK_EVENTS=0``) every
+stamp site is a single flag/None check — no dict allocation, no clock
+read.  The driver's flag is authoritative for a task: a spec submitted
+without a phases dict is never stamped downstream (head and worker sites
+gate on ``spec.phases is not None``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+# Canonical phase-stamp vocabulary, in lifecycle order.  graftlint GL008
+# checks literal stamp() sites against this set; the head's record join and
+# the monotonic-ordering test both iterate it in order.
+PHASES = (
+    "submit",  # driver: spec built, about to enqueue on the head conn
+    "head_enqueue",  # head: SUBMIT frame decoded, entering the task table
+    "dispatch",  # head: scheduler picked a worker, PUSH_TASK sent
+    "worker_dequeue",  # worker: execution loop picked the task up
+    "arg_fetch_start",  # worker: resolving args + fetching the function
+    "arg_fetch_end",
+    "exec_start",  # worker: user code entered
+    "exec_end",
+    "put_start",  # worker: serializing + storing return values
+    "put_end",
+    "done",  # head: TASK_DONE frame joined into the record
+)
+
+# Derived per-phase durations: name -> (start stamp, end stamp).
+# queue_wait/arg_fetch/exec/put pair stamps from ONE process and are immune
+# to cross-node clock skew; deliver (head→worker) and e2e (driver→head)
+# cross processes — exact on one host, ±NTP skew across nodes, and always
+# clamped at 0 so skew can never emit negative latencies.
+DURATIONS = {
+    "queue_wait": ("head_enqueue", "dispatch"),
+    "deliver": ("dispatch", "worker_dequeue"),
+    "arg_fetch": ("arg_fetch_start", "arg_fetch_end"),
+    "exec": ("exec_start", "exec_end"),
+    "put": ("put_start", "put_end"),
+    "e2e": ("submit", "done"),
+}
+
+# Histogram boundaries for the per-phase latency metrics (seconds).  Wide
+# range: queue-wait on an idle cluster is sub-millisecond, a cold TPU
+# worker spawn or a chaos-delayed dispatch reaches tens of seconds.
+PHASE_HISTOGRAM_BOUNDARIES = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+PHASE_METRIC = "ray_tpu_task_phase_seconds"
+PHASE_METRIC_HELP = (
+    "Per-phase task lifecycle latency (flight recorder), tagged by "
+    "phase/name/node"
+)
+
+# THE flag: stamp sites check this module attribute directly
+# (`if task_events.enabled: ...`) so the disabled hot path costs one
+# attribute load + truth test per site.
+enabled: bool = os.environ.get("RAY_TPU_TASK_EVENTS", "1") not in ("0", "false", "")
+
+
+def set_enabled(on: bool) -> None:
+    """Flip recording for THIS process (tests / programmatic opt-out).
+    Cluster-wide default comes from RAY_TPU_TASK_EVENTS in each process's
+    environment."""
+    global enabled
+    enabled = bool(on)
+
+
+def new_phases() -> Dict[str, float]:
+    """Fresh stamp dict for a spec being submitted now."""
+    return {"submit": time.time()}
+
+
+def stamp(phases: Optional[Dict[str, float]], phase: str) -> None:
+    """Record `phase` at now.  Callers gate on `task_events.enabled` (or
+    `spec.phases is not None`) BEFORE calling, keeping the disabled path
+    to a single flag check; stamp() itself tolerates None for belt and
+    suspenders at cold call sites."""
+    if phases is not None:
+        phases[phase] = time.time()
+
+
+def durations(phases: Dict[str, float]) -> Dict[str, float]:
+    """Per-phase durations (seconds) for the stamps present in a record.
+    Missing stamps skip their phase; clamped at 0 so a stray clock step
+    can't emit negative latencies into the histograms."""
+    out: Dict[str, float] = {}
+    for name, (a, b) in DURATIONS.items():
+        ta, tb = phases.get(a), phases.get(b)
+        if ta is not None and tb is not None:
+            out[name] = max(0.0, tb - ta)
+    return out
+
+
+def ordered(phases: Dict[str, float]) -> list:
+    """The record's stamps in canonical lifecycle order — what the
+    monotonicity invariant is asserted over."""
+    return [(p, phases[p]) for p in PHASES if p in phases]

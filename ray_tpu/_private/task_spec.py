@@ -63,6 +63,13 @@ class TaskSpec:
     # parent_span_id / span_id — the reference's injected span metadata
     # (tracing_helper.py _DictPropagator)
     trace_ctx: Optional[Dict[str, str]] = None
+    # flight-recorder stamps (_private/task_events.py): phase -> wall time.
+    # None when recording is off — every downstream stamp site gates on
+    # that, so the disabled hot path is one None check.  The dict object is
+    # SHARED between the spec and its wire form (to_wire is a shallow copy;
+    # from_wire adopts the decoded dict), which is what lets the head stamp
+    # dispatch into a spec whose cached submit wire is reused for PUSH_TASK.
+    phases: Optional[Dict[str, float]] = None
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

@@ -69,6 +69,16 @@ def _new_span():
     return new_span_context()
 
 
+def _new_phases():
+    """Flight-recorder stamp dict for a spec being built now, or None when
+    recording is off (the single submit-side flag check)."""
+    from ray_tpu._private import task_events
+
+    if not task_events.enabled:
+        return None
+    return task_events.new_phases()
+
+
 def _error_from_string(msg: str) -> Exception:
     head, _, rest = msg.partition(":")
     cls = _ERROR_CLASSES.get(head.strip())
@@ -960,6 +970,7 @@ class CoreWorker:
             node_affinity=node_affinity,
             caller_id=self.worker_id.binary(),
             trace_ctx=_new_span(),
+            phases=_new_phases(),
             runtime_env=runtime_env or {},
         )
         # fire-and-forget on the ordered conn: queueing cannot fail in a
@@ -1019,6 +1030,7 @@ class CoreWorker:
             node_affinity=node_affinity,
             caller_id=self.worker_id.binary(),
             trace_ctx=_new_span(),
+            phases=_new_phases(),
             runtime_env=runtime_env or {},
         )
         self.request(MsgType.CREATE_ACTOR, {"spec": spec.to_wire()})
@@ -1052,6 +1064,7 @@ class CoreWorker:
             seq_no=seq,
             caller_id=self.worker_id.binary(),
             trace_ctx=_new_span(),
+            phases=_new_phases(),
         )
         conn = self._direct_conn(actor_id)
         if conn is not None:
@@ -1460,6 +1473,7 @@ class CoreWorker:
         exec_start: float = 0.0,
         exec_end: float = 0.0,
         contained: Optional[Dict[bytes, List[bytes]]] = None,
+        phases: Optional[Dict[str, float]] = None,
     ):
         # refs this task created locally (e.g. deserialized ref-args kept
         # in actor state) must be declared BEFORE the head unpins the args
@@ -1479,6 +1493,9 @@ class CoreWorker:
                     # refs pickled inside each sealed return value → the head
                     # pins them for the return object's lifetime
                     "contained": contained or {},
+                    # flight-recorder stamps accumulated across the hops
+                    # (task_events.py); None/{} when recording is off
+                    "phases": phases or {},
                 },
             )
         )

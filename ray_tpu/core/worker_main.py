@@ -152,6 +152,11 @@ class WorkerRuntime:
         stored_error = False
         exec_start = _time.time()
         direct = reply_to is not None
+        # flight-recorder stamps ride the spec (task_events.py); None when
+        # recording is off — each stamp site below is one None check
+        ph = spec.phases
+        if ph is not None:
+            ph["worker_dequeue"] = exec_start
         try:
             if spec.task_id in self.cancelled:
                 raise RayTaskError(
@@ -159,6 +164,8 @@ class WorkerRuntime:
                     "TaskCancelledError: cancelled",
                 )
             results = self._execute(spec)
+            if ph is not None:
+                ph["exec_end"] = ph["put_start"] = _time.time()
             outs = self._normalize_returns(spec, results)
             limit = RayConfig.max_direct_call_object_size
             for oid, value in outs:
@@ -179,6 +186,8 @@ class WorkerRuntime:
                 sealed.append(oid)
                 if sobj.contained:
                     contained[oid] = sobj.contained
+            if ph is not None:
+                ph["put_end"] = _time.time()
         except BaseException as e:  # noqa: BLE001
             name = spec.function_name or spec.method_name
             if isinstance(e, RayTaskError):
@@ -219,6 +228,7 @@ class WorkerRuntime:
                         exec_start=exec_start,
                         exec_end=_time.time(),
                         contained=contained,
+                        phases=ph,
                     )
             except Exception:
                 traceback.print_exc(file=sys.stderr)
@@ -242,6 +252,7 @@ class WorkerRuntime:
                 exec_start=exec_start,
                 exec_end=_time.time(),
                 contained=contained,
+                phases=ph,
             )
         except Exception:
             traceback.print_exc(file=sys.stderr)
@@ -270,6 +281,14 @@ class WorkerRuntime:
             return self._execute_inner(spec)
 
     def _execute_inner(self, spec: TaskSpec):
+        import time as _time
+
+        # arg-fetch phase covers runtime-env materialization, argument
+        # resolution (ref pulls), and the function-table fetch — everything
+        # between dequeue and the first line of user code
+        ph = spec.phases
+        if ph is not None:
+            ph["arg_fetch_start"] = _time.time()
         undo_env = self._apply_runtime_env(spec)
         if spec.task_type == NORMAL_TASK:
             # pool workers are reused: the env (sys.path entries, env vars,
@@ -279,6 +298,8 @@ class WorkerRuntime:
             try:
                 args, kwargs = self.cw.decode_args(spec.args)
                 fn = self.cw.fetch_function(spec.function_id)
+                if ph is not None:
+                    ph["arg_fetch_end"] = ph["exec_start"] = _time.time()
                 return fn(*args, **kwargs)
             finally:
                 undo_env()
@@ -297,10 +318,14 @@ class WorkerRuntime:
             if concurrency > 1:
                 self.actor.executor = ThreadPoolExecutor(max_workers=concurrency)
                 self._concurrency_sem = threading.Semaphore(concurrency)
+            if ph is not None:
+                ph["arg_fetch_end"] = ph["exec_start"] = _time.time()
             self.actor.instance = cls(*args, **kwargs)
             self._start_direct_server(spec.actor_id)
             return None
         if spec.task_type == ACTOR_TASK:
+            if ph is not None:
+                ph["arg_fetch_end"] = ph["exec_start"] = _time.time()
             inst = self.actor.instance
             if inst is None:
                 raise RuntimeError("actor instance not initialized")

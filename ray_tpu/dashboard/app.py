@@ -63,6 +63,17 @@ class DashboardServer:
         async def api_timeline(request):
             return _json(ray_tpu.timeline())
 
+        async def api_task_summary(request):
+            """Flight-recorder per-phase latency summary (p50/p95/max per
+            task name); ?records=N appends the N most recent raw records."""
+            from ray_tpu.experimental.state import summarize_tasks
+
+            try:
+                limit = int(request.query.get("records", 0))
+            except ValueError:
+                limit = 0
+            return _json(summarize_tasks(limit=limit))
+
         async def api_events(request):
             from ray_tpu.experimental.state.api import list_cluster_events
 
@@ -130,7 +141,9 @@ class DashboardServer:
             <p>JSON: <a href=/api/cluster>cluster</a> <a href=/api/nodes>nodes</a>
             <a href=/api/actors>actors</a> <a href=/api/tasks>tasks</a>
             <a href=/api/pgs>pgs</a> <a href=/api/metrics>metrics</a>
-            <a href=/api/timeline>timeline</a> <a href=/api/events>events</a>
+            <a href=/api/timeline>timeline</a>
+            <a href=/api/task_summary>task_summary</a>
+            <a href=/api/events>events</a>
             <a href=/api/objects>objects</a></p>
             </body></html>"""
             return web.Response(text=html, content_type="text/html")
@@ -144,6 +157,7 @@ class DashboardServer:
         app.router.add_get("/api/pgs", api_pgs)
         app.router.add_get("/api/metrics", api_metrics)
         app.router.add_get("/api/timeline", api_timeline)
+        app.router.add_get("/api/task_summary", api_task_summary)
         app.router.add_get("/api/events", api_events)
         app.router.add_get("/api/objects", api_objects)
         app.router.add_get("/api/serve/applications", api_serve_get)

@@ -5,4 +5,5 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
     list_objects,
     list_placement_groups,
     list_tasks,
+    summarize_tasks,
 )

@@ -75,6 +75,14 @@ def list_objects(limit: int = 1000) -> List[dict]:
     ]
 
 
+def summarize_tasks(limit: int = 0) -> Dict:
+    """Per-phase task latency summary (p50/p95/max per task name) from the
+    head's flight recorder, plus the raw joined records when `limit` > 0
+    (reference analog: `ray summary tasks`, state/state_cli.py backed by
+    the task-event pipeline)."""
+    return _cw().request(MsgType.TASK_SUMMARY, {"limit": limit})
+
+
 def list_cluster_events(limit: int = 1000) -> List[dict]:
     """Structured lifecycle events: node/actor/worker transitions, OOM
     kills, spill passes (reference analog: src/ray/util/event.h + the

@@ -311,6 +311,14 @@ class HeadServer:
         # + dashboard event module): lifecycle transitions worth surfacing
         # to operators, ring-buffered and queryable via LIST_EVENTS
         self.events: "deque" = deque(maxlen=5000)
+        # flight recorder (task_events.py): per-task joined phase records —
+        # the source for TASK_SUMMARY / `ray-tpu summary tasks`; per-phase
+        # histograms live in self.kv under metrics:* (written via
+        # _observe_phase) so every metrics scrape surface sees them
+        self.task_records: "deque" = deque(maxlen=4096)
+        # parsed histogram records cached by kv key: one json.dumps per
+        # observe instead of a loads+dumps round trip on the done path
+        self._phase_hist_cache: Dict[str, dict] = {}
 
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
@@ -381,8 +389,20 @@ class HeadServer:
         # head node's own Prometheus scrape endpoint (raylets run their own)
         from ray_tpu.raylet.metrics_agent import start_metrics_server
 
+        def _head_app_metrics() -> str:
+            # the agent shares this process and loop: render the app
+            # metrics (incl. flight-recorder phase histograms) straight
+            # from the kv table, no connected worker needed
+            from ray_tpu.util import metrics as metrics_mod
+
+            return metrics_mod.render_prometheus(
+                metrics_mod.merge_series(metrics_mod.raw_records_from_kv(self.kv))
+            )
+
         try:
-            mport = await start_metrics_server(self.head_node_id.hex(), self._store)
+            mport = await start_metrics_server(
+                self.head_node_id.hex(), self._store, app_metrics=_head_app_metrics
+            )
             node.labels["metrics_addr"] = f"{advertise}:{mport}"
         except Exception as e:  # noqa: BLE001
             logger.warning("head metrics endpoint unavailable: %s", e)
@@ -928,7 +948,41 @@ class HeadServer:
             actor = self.actors.get(w.actor_id)
             if actor is not None:
                 await self._on_actor_worker_dead(actor, reason)
+        self._retire_worker_metrics(wid)
         self._kick_scheduler()
+
+    def _retire_worker_metrics(self, wid: bytes):
+        """Fold a dead worker's per-process metric series into one durable
+        ``:retired`` series per (metric, tags) and drop the per-worker
+        keys — without this, worker churn grows the metrics: namespace
+        (and every scrape payload) by one immortal record per dead
+        process.  Counters and histograms keep their totals; a dead
+        worker's gauge is a stale point-in-time reading and dies with it."""
+        import json as _json
+
+        from ray_tpu.util import metrics as metrics_mod
+
+        suffix = ":" + wid.hex()[:12]
+        for key in [
+            k for k in self.kv if k.startswith("metrics:") and k.endswith(suffix)
+        ]:
+            blob = self.kv.pop(key)
+            try:
+                rec = _json.loads(blob)
+            except (ValueError, TypeError):
+                continue
+            if rec.get("kind") == "gauge":
+                continue
+            rkey = key[: -len(suffix)] + ":retired"
+            cur_blob = self.kv.get(rkey)
+            if cur_blob is not None:
+                try:
+                    cur = _json.loads(cur_blob)
+                    metrics_mod.merge_records(cur, rec)
+                    rec = cur
+                except (ValueError, TypeError):
+                    pass  # corrupt retired record: replace it outright
+            self.kv[rkey] = _json.dumps(rec).encode()
 
     async def _on_actor_worker_dead(self, actor: ActorInfo, reason: str):
         if actor.state == ACTOR_DEAD:
@@ -1593,6 +1647,12 @@ class HeadServer:
 
     async def h_submit_task(self, cid, conn, p):
         spec = TaskSpec.from_wire(p["spec"])
+        # flight recorder: the phases dict is SHARED with p["spec"] (the
+        # cached wire reused for PUSH_TASK), so this stamp reaches the
+        # worker too.  None when the submitting driver has recording off —
+        # that one check is the whole disabled-path cost here.
+        if spec.phases is not None:
+            spec.phases["head_enqueue"] = time.time()
         for oid in spec.return_object_ids():
             self._object_entry(oid)
         # pin ref-args until the task completes so an eager driver-side
@@ -1641,6 +1701,12 @@ class HeadServer:
         if w is None:
             actor.pending_calls.append(spec)
             return
+        if spec.phases is not None:
+            # actor calls queue in pending_calls while the actor creates /
+            # restarts; dispatch is stamped at the actual push so
+            # queue_wait covers that wait, like scheduler queueing does
+            # for normal tasks
+            spec.phases["dispatch"] = time.time()
         entry = TaskEntry(spec, -1)
         entry.state = "RUNNING"
         entry.worker_id = w.worker_id
@@ -1679,6 +1745,10 @@ class HeadServer:
                     "error": bool(p.get("error")),
                     # span chain when tracing is on (util/tracing.py)
                     "trace": (entry_for_tl.spec.trace_ctx or {}) if entry_for_tl else {},
+                    # flight-recorder stamps → per-phase sub-spans in the
+                    # chrome-trace export (h_timeline)
+                    "phases": self._join_task_phases(p, entry_for_tl, w),
+                    "task_id": bytes(tid).hex(),
                 }
             )
         if entry is not None:
@@ -2064,7 +2134,13 @@ class HeadServer:
 
     async def h_kv_keys(self, cid, conn, p):
         pref = p.get("prefix", "")
-        return {"keys": [k for k in self.kv if k.startswith(pref)]}
+        keys = [k for k in self.kv if k.startswith(pref)]
+        if p.get("values"):
+            # prefix-ranged multi-get: one frame instead of 1+N round
+            # trips (the raylet metrics agents scrape the metrics:*
+            # namespace this way every Prometheus interval)
+            return {"keys": keys, "values": {k: self.kv[k] for k in keys}}
+        return {"keys": keys}
 
     async def h_kv_exists(self, cid, conn, p):
         return {"exists": p["key"] in self.kv}
@@ -2141,6 +2217,99 @@ class HeadServer:
             if e.state != "QUEUED":
                 out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
         return {"tasks": out, "finished": self.finished_task_count}
+
+    # -------------------------------------------------------- flight recorder
+
+    def _join_task_phases(self, p: dict, entry, w) -> dict:
+        """Join the TASK_DONE stamps with head-side context into one flight
+        record, aggregate per-phase histograms, and return the stamp dict
+        for the timeline event.  One truthiness check when recording is off
+        (the worker sends phases={} then)."""
+        wire_phases = p.get("phases")
+        if not wire_phases:
+            return {}
+        from ray_tpu._private import task_events
+
+        phases = {str(k): float(v) for k, v in wire_phases.items()}
+        phases["done"] = time.time()
+        spec = entry.spec if entry is not None else None
+        name = (spec.function_name or spec.method_name) if spec else "task"
+        node_hex = (entry.node_id.hex() if entry and entry.node_id else "")
+        durs = task_events.durations(phases)
+        self.task_records.append(
+            {
+                "task_id": bytes(p["task_id"]).hex(),
+                "name": name or "task",
+                "node_id": node_hex,
+                "pid": w.pid if w else 0,
+                "error": bool(p.get("error")),
+                "trace": (spec.trace_ctx or {}) if spec else {},
+                "phases": phases,
+                "durations": durs,
+            }
+        )
+        for phase, dur in durs.items():
+            self._observe_phase(phase, name or "task", node_hex, dur)
+        return phases
+
+    def _observe_phase(self, phase: str, name: str, node_hex: str, dur: float):
+        """Fold one phase duration into the cluster-wide per-phase
+        histograms, written through to self.kv under metrics:* so the
+        normal scrape surfaces (util/metrics.read_all, per-node /metrics)
+        pick them up like any app metric.  Deliberately NOT WAL-persisted
+        (direct kv mutation, like chaos:plan): latency history dies with
+        the head incarnation."""
+        import json as _json
+
+        from ray_tpu._private import task_events
+        from ray_tpu.util import metrics as metrics_mod
+
+        tags = {"phase": phase, "name": name, "node": node_hex[:12]}
+        key = (
+            f"metrics:{task_events.PHASE_METRIC}:"
+            f"{metrics_mod.tag_string(tags)}:head"
+        )
+        rec = self._phase_hist_cache.get(key)
+        if rec is None:
+            rec = metrics_mod.new_histogram_record(
+                task_events.PHASE_METRIC_HELP,
+                task_events.PHASE_HISTOGRAM_BOUNDARIES,
+            )
+            rec["tags"] = tags
+            self._phase_hist_cache[key] = rec
+        metrics_mod.observe_into(rec, dur)
+        self.kv[key] = _json.dumps(rec).encode()
+
+    async def h_task_summary(self, cid, conn, p):
+        """Per-phase latency summary (p50/p95/max) over the joined flight
+        records, grouped by task name — the backend of `ray-tpu summary
+        tasks` and the dashboard's /api/task_summary (reference analog:
+        `ray summary tasks`, state/state_cli.py)."""
+        limit = int(p.get("limit", 0))
+        records = list(self.task_records)
+        groups: Dict[Tuple[str, str], List[float]] = {}
+        for rec in records:
+            for phase, dur in rec["durations"].items():
+                groups.setdefault((rec["name"], phase), []).append(dur)
+        summary = []
+        for (name, phase), vals in sorted(groups.items()):
+            vals.sort()
+            n = len(vals)
+            summary.append(
+                {
+                    "name": name,
+                    "phase": phase,
+                    "count": n,
+                    "p50": vals[int(0.50 * (n - 1))],
+                    "p95": vals[int(0.95 * (n - 1))],
+                    "max": vals[-1],
+                    "mean": sum(vals) / n,
+                }
+            )
+        out = {"summary": summary, "total_records": len(records)}
+        if limit > 0:
+            out["records"] = records[-limit:]
+        return out
 
     def _chaos_emit(self, ev: dict):
         self._record_event("WARNING", "chaos", ev["message"], **ev["fields"])
@@ -2233,11 +2402,26 @@ class HeadServer:
             )
         return {"objects": out, "total": len(self.objects)}
 
+    # timeline sub-span labels per flight-recorder duration (task_events
+    # .DURATIONS keys); e2e spans both processes and stays implicit in the
+    # submit→done stamps carried in args
+    _TIMELINE_PHASES = (
+        ("queue-wait", "head_enqueue", "dispatch"),
+        ("deliver", "dispatch", "worker_dequeue"),
+        ("arg-fetch", "arg_fetch_start", "arg_fetch_end"),
+        ("exec", "exec_start", "exec_end"),
+        ("put", "put_start", "put_end"),
+    )
+
     async def h_timeline(self, cid, conn, p):
-        """Chrome-trace events of recent task executions
+        """Chrome-trace events of recent task executions, nested per-phase
+        sub-spans from the flight recorder, and cluster events (chaos
+        faults, node/worker transitions) as instant markers — one view for
+        fault → latency-spike causality
         (reference: `ray timeline` scripts.py → profile table dump)."""
         events = []
         for e in self.timeline:
+            trace = e.get("trace") or {}
             events.append(
                 {
                     "name": e["name"],
@@ -2247,8 +2431,47 @@ class HeadServer:
                     "dur": e["dur"] * 1e6,
                     "pid": e["pid"],
                     "tid": e["pid"],
-                    "args": {"error": e["error"], **(e.get("trace") or {})},
-                    "trace": e.get("trace") or {},
+                    "args": {"error": e["error"], **trace},
+                    "trace": trace,
+                }
+            )
+            phases = e.get("phases") or {}
+            for label, start, end in self._TIMELINE_PHASES:
+                ts, te = phases.get(start), phases.get(end)
+                if ts is None or te is None:
+                    continue
+                events.append(
+                    {
+                        "name": f"{e['name']}:{label}",
+                        "cat": "task_phase",
+                        "ph": "X",
+                        "ts": ts * 1e6,
+                        "dur": max(0.0, te - ts) * 1e6,
+                        "pid": e["pid"],
+                        "tid": e["pid"],
+                        "args": {
+                            "phase": label,
+                            "task_id": e.get("task_id", ""),
+                            **trace,
+                        },
+                        "trace": trace,
+                    }
+                )
+        for ev in self.events:
+            events.append(
+                {
+                    "name": f"{ev.get('source', '')}: {ev.get('message', '')}",
+                    "cat": f"event:{ev.get('source', '')}",
+                    "ph": "i",
+                    "s": "g",
+                    "ts": ev.get("timestamp", 0.0) * 1e6,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {
+                        k: v
+                        for k, v in ev.items()
+                        if k not in ("timestamp", "message", "source")
+                    },
                 }
             )
         return {"events": events}
@@ -2564,6 +2787,10 @@ class HeadServer:
 
     async def _dispatch(self, entry: TaskEntry, node: NodeInfo, worker: WorkerInfo):
         spec = entry.spec
+        if spec.phases is not None:
+            # shared with entry.wire (see h_submit_task), so the stamp
+            # rides the cached PUSH_TASK frame to the worker
+            spec.phases["dispatch"] = time.time()
         entry.state = "RUNNING"
         entry.worker_id = worker.worker_id
         entry.node_id = node.node_id
@@ -2714,4 +2941,5 @@ HeadServer._HANDLERS = {
     MsgType.LIST_NODES: HeadServer.h_list_nodes,
     MsgType.LIST_TASKS: HeadServer.h_list_tasks,
     MsgType.TIMELINE: HeadServer.h_timeline,
+    MsgType.TASK_SUMMARY: HeadServer.h_task_summary,
 }

@@ -4,13 +4,19 @@ Analog of the reference's per-node reporter agent (reference:
 dashboard/modules/reporter/reporter_agent.py — psutil node stats +
 _private/metrics_agent.py:63 Prometheus export).  Each raylet (and the
 head, for its own node) serves ``/metrics`` with node CPU/memory, object
-store occupancy, and this process's ray_tpu.util.metrics registry, so a
-stock Prometheus scrape_config covers the whole cluster node-by-node.
+store occupancy, JAX device gauges (HBM used/total via
+``device.memory_stats()``, device count/kind), and the cluster's
+application metrics (ray_tpu.util.metrics registry, including the
+flight-recorder phase histograms) — so a stock Prometheus scrape_config
+covers scheduler health AND TPU memory pressure node-by-node.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import inspect
+import os
+import sys
+from typing import Callable, Optional
 
 
 def _node_stats_text(node_id_hex: str, store=None) -> str:
@@ -46,20 +52,111 @@ def _node_stats_text(node_id_hex: str, store=None) -> str:
     return "\n".join(lines) + "\n"
 
 
-async def start_metrics_server(node_id_hex: str, store=None, port: int = 0) -> int:
-    """Serve /metrics on this node; returns the bound port."""
+def _jax_probe_allowed() -> bool:
+    """May this process touch jax.devices()?  Importing jax can CLAIM the
+    TPU (the axon tunnel claims at backend init), and the agent lives in
+    head/raylet processes that must never steal the chip from the worker
+    that owns it.  Probe only when it cannot claim (explicit CPU backend),
+    when jax is already resident in this process, or when the operator
+    opted in with RAY_TPU_DEVICE_METRICS=1."""
+    flag = os.environ.get("RAY_TPU_DEVICE_METRICS", "").strip().lower()
+    if flag in ("0", "false", "no", "off"):
+        return False
+    if flag:
+        return True
+    if "jax" in sys.modules:
+        return True
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def _device_stats_text(node_id_hex: str) -> str:
+    """JAX device gauges: count/kind always, HBM used/total per device
+    where the backend reports memory_stats (TPU; CPU devices return None).
+    Family # TYPE headers are emitted even when a backend yields no
+    memory samples, so scrapers always see the families."""
+    if not _jax_probe_allowed():
+        return ""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:  # graftlint: disable=silent-except -- no usable jax backend in this process; node stats still serve
+        return ""
+    lines = [
+        "# HELP jax_device_count JAX-visible devices on this node",
+        "# TYPE jax_device_count gauge",
+        f'jax_device_count{{NodeId="{node_id_hex}"}} {len(devices)}',
+        "# HELP jax_device_hbm_used_bytes Device memory in use"
+        " (device.memory_stats bytes_in_use)",
+        "# TYPE jax_device_hbm_used_bytes gauge",
+    ]
+    used_lines, total_lines = [], []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # graftlint: disable=silent-except -- backend without memory introspection; count/kind gauges still serve
+            stats = None
+        labels = (
+            f'{{NodeId="{node_id_hex}",device="{d.id}",kind="{d.device_kind}"}}'
+        )
+        if not stats:
+            continue
+        if "bytes_in_use" in stats:
+            used_lines.append(
+                f"jax_device_hbm_used_bytes{labels} {stats['bytes_in_use']}"
+            )
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            total_lines.append(
+                f"jax_device_hbm_total_bytes{labels} {limit}"
+            )
+    lines.extend(used_lines)
+    lines.append(
+        "# HELP jax_device_hbm_total_bytes Device memory capacity"
+        " (device.memory_stats bytes_limit)"
+    )
+    lines.append("# TYPE jax_device_hbm_total_bytes gauge")
+    lines.extend(total_lines)
+    return "\n".join(lines) + "\n"
+
+
+async def start_metrics_server(
+    node_id_hex: str,
+    store=None,
+    port: int = 0,
+    app_metrics: Optional[Callable[[], object]] = None,
+) -> int:
+    """Serve /metrics on this node; returns the bound port.
+
+    ``app_metrics`` supplies the application-metrics section as
+    Prometheus text (sync or async callable): the head passes a renderer
+    over its own kv table, raylets pass an async reader that pulls the
+    metrics records from the head.  Without it, the legacy in-process
+    fallback (a connected worker's prometheus_text) is attempted."""
+    import asyncio
+
     from aiohttp import web
 
     from ray_tpu.util import metrics as metrics_mod
 
     async def handle(_request):
         body = _node_stats_text(node_id_hex, store)
+        # first device probe may import jax (seconds): keep the event loop
+        # serving — the head's RPC loop shares it
+        body += await asyncio.get_running_loop().run_in_executor(
+            None, _device_stats_text, node_id_hex
+        )
         try:
-            # app metrics live in the cluster KV: only reachable from a
-            # connected process (the head/raylet agent itself isn't a
-            # driver, so node stats alone are served there)
-            body += metrics_mod.prometheus_text()
-        except Exception:  # graftlint: disable=silent-except -- disconnected agent serves node stats only, by design (comment above)
+            if app_metrics is not None:
+                out = app_metrics()
+                if inspect.isawaitable(out):
+                    out = await out
+                body += out or ""
+            else:
+                # app metrics live in the cluster KV: only reachable from a
+                # connected process (a bare agent serves node stats only)
+                body += metrics_mod.prometheus_text()
+        except Exception:  # graftlint: disable=silent-except -- app-metrics source unavailable (disconnected agent / head mid-restart); node+device stats still serve, by design
             pass
         return web.Response(text=body, content_type="text/plain")
 

@@ -111,8 +111,31 @@ class Raylet:
         # dashboard reporter_agent.py)
         from ray_tpu.raylet.metrics_agent import start_metrics_server
 
+        async def _app_metrics() -> str:
+            # pull the cluster's app-metrics records (incl. flight-recorder
+            # phase histograms) from the head KV over the raylet's control
+            # connection; conn is set after registration, scrapes before
+            # that serve node stats only
+            conn = getattr(self, "conn", None)
+            if conn is None:
+                return ""
+            from ray_tpu.util import metrics as metrics_mod
+
+            # prefix-ranged multi-get: ONE round trip per scrape, not 1+N
+            reply = await conn.request(
+                MsgType.KV_KEYS, {"prefix": "metrics:", "values": True}, 10
+            )
+            raw = {
+                str(k): bytes(v) for k, v in (reply.get("values") or {}).items()
+            }
+            return metrics_mod.render_prometheus(
+                metrics_mod.merge_series(metrics_mod.raw_records_from_kv(raw))
+            )
+
         try:
-            metrics_port = await start_metrics_server(self.node_id.hex(), self.store)
+            metrics_port = await start_metrics_server(
+                self.node_id.hex(), self.store, app_metrics=_app_metrics
+            )
         except Exception as e:  # noqa: BLE001
             print(f"raylet: metrics endpoint unavailable: {e}", file=sys.stderr)
             metrics_port = 0

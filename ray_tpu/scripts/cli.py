@@ -1,9 +1,10 @@
-"""CLI: ray-tpu start/stop/status/submit/memory/timeline.
+"""CLI: ray-tpu start/stop/status/submit/memory/metrics/timeline/summary.
 
 Analog of the reference's scripts (reference: python/ray/scripts/
-scripts.py — start:532, stop:980, status, memory, timeline, submit:1466).
-Invoke as ``python -m ray_tpu.scripts.cli <cmd>`` (or the ray-tpu
-entrypoint when installed).
+scripts.py — start:532, stop:980, status, memory, timeline, submit:1466;
+`ray summary tasks` from state/state_cli.py).  Invoke as
+``python -m ray_tpu.scripts.cli <cmd>`` (or the ray-tpu entrypoint when
+installed).
 """
 
 from __future__ import annotations
@@ -141,6 +142,47 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_timeline(args):
+    """Export the cluster timeline — task exec windows, flight-recorder
+    per-phase sub-spans, cluster-event markers — as a chrome://tracing
+    JSON file (reference: `ray timeline`, scripts.py:timeline)."""
+    import ray_tpu
+
+    ray_tpu.init(address=_read_address(args))
+    out = args.output or f"/tmp/ray-tpu-timeline-{int(time.time())}.json"
+    events = ray_tpu.timeline(filename=out)
+    print(f"wrote {len(events)} events to {out}")
+    print("open chrome://tracing and load the file to view")
+    return 0
+
+
+def cmd_summary(args):
+    """`ray-tpu summary tasks`: per-phase latency table (p50/p95/max per
+    task name) from the head's flight recorder."""
+    if args.what != "tasks":
+        print(f"unknown summary kind {args.what!r} (supported: tasks)", file=sys.stderr)
+        return 1
+    import ray_tpu  # noqa: F401  (init side effect)
+    from ray_tpu.experimental.state import summarize_tasks
+
+    ray_tpu.init(address=_read_address(args))
+    reply = summarize_tasks()
+    rows = reply.get("summary", [])
+    if not rows:
+        print("no flight records yet (is RAY_TPU_TASK_EVENTS=0, or no tasks run?)")
+        return 0
+    hdr = f"{'task':28s} {'phase':12s} {'count':>7s} {'p50':>10s} {'p95':>10s} {'max':>10s}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(
+            f"{r['name'][:28]:28s} {r['phase']:12s} {r['count']:7d} "
+            f"{r['p50'] * 1e3:9.2f}ms {r['p95'] * 1e3:9.2f}ms {r['max'] * 1e3:9.2f}ms"
+        )
+    print(f"({reply.get('total_records', 0)} records joined at the head)")
+    return 0
+
+
 def main():
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -160,6 +202,16 @@ def main():
         p = sub.add_parser(name)
         p.add_argument("--address", default=None)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("timeline", help="export a chrome://tracing JSON of recent tasks")
+    p.add_argument("--address", default=None)
+    p.add_argument("--output", "-o", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("summary", help="latency summaries from the flight recorder")
+    p.add_argument("what", choices=["tasks"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("submit", help="submit a job entrypoint command")
     p.add_argument("--address", default=None)

@@ -14,6 +14,12 @@ stamps.  This rule pins that schema at the call sites:
   dashboards.
 - ``conn.send(MsgType.RECORD_EVENT, {...})`` payload literals: same
   severity vocabulary, and "fields" must obey the same key rules.
+- flight-recorder phase stamps (_private/task_events.py): a literal
+  phase name written into a stamp dict (``ph["..."] = ...`` /
+  ``spec.phases["..."] = ...`` / ``task_events.stamp(d, "...")``) must
+  come from the canonical ``task_events.PHASES`` vocabulary — a typo'd
+  phase silently vanishes from every duration, histogram, and timeline
+  sub-span that joins on the canonical names.
 
 Non-literal arguments are skipped (runtime sanitization in
 h_record_event covers them).
@@ -35,6 +41,18 @@ from ray_tpu.tools.graftlint.core import (
 _SEVERITIES = {"DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"}
 _ENVELOPE = {"severity", "source", "message", "timestamp"}
 _CLOCK_DRIFT = {"time", "date", "ts", "datetime", "timestamp_ms", "when"}
+
+# Stamp-dict spellings the phase-vocabulary check binds to.  Narrow on
+# purpose: `ph` / `phases` locals and `.phases` attributes are the
+# flight-recorder idiom (task_events.py); arbitrary dicts stay unchecked.
+_PHASE_DICT_NAMES = {"ph", "phases"}
+
+
+def _phase_vocabulary() -> set:
+    # single source of truth: the canonical tuple in task_events.py
+    from ray_tpu._private.task_events import PHASES
+
+    return set(PHASES)
 
 
 def _const_str(node: Optional[ast.expr]) -> Optional[str]:
@@ -65,6 +83,9 @@ class EventRecordSchemaChecker(FileChecker):
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                yield from self._check_phase_stamp_targets(ctx, node)
+                continue
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
@@ -73,6 +94,35 @@ class EventRecordSchemaChecker(FileChecker):
                 yield from self._check_direct(ctx, node)
             elif name in ("send", "request") and _is_record_event_send(node):
                 yield from self._check_wire(ctx, node)
+            elif name == "stamp" and len(node.args) >= 2:
+                yield from self._check_phase_name(ctx, node, _const_str(node.args[1]))
+
+    @staticmethod
+    def _is_phase_dict(base: ast.expr) -> bool:
+        if isinstance(base, ast.Name):
+            return base.id in _PHASE_DICT_NAMES
+        return isinstance(base, ast.Attribute) and base.attr == "phases"
+
+    def _check_phase_stamp_targets(self, ctx: FileContext, node: ast.Assign) -> Iterator[Finding]:
+        for target in node.targets:
+            if not isinstance(target, ast.Subscript):
+                continue
+            if not self._is_phase_dict(target.value):
+                continue
+            yield from self._check_phase_name(ctx, target, _const_str(target.slice))
+
+    def _check_phase_name(self, ctx: FileContext, node, phase) -> Iterator[Finding]:
+        if phase is None:
+            return  # non-literal: the runtime vocabulary owns it
+        vocab = _phase_vocabulary()
+        if phase not in vocab:
+            yield ctx.finding(
+                self.rule,
+                node,
+                f"phase stamp {phase!r} is not in the canonical "
+                f"task_events.PHASES vocabulary {sorted(vocab)}: a drifted "
+                "name drops out of every duration/histogram/timeline join",
+            )
 
     def _check_direct(self, ctx: FileContext, node: ast.Call) -> Iterator[Finding]:
         sev = _const_str(node.args[0]) if node.args else None

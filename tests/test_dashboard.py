@@ -51,6 +51,12 @@ def test_dashboard_endpoints(ray_cluster):
     assert "ray_tpu cluster" in html
     metrics_text = fetch("/api/metrics")
     assert isinstance(metrics_text, str)
+    # flight-recorder summary endpoint: the actor round trips above left
+    # joined records at the head
+    summary = json.loads(fetch("/api/task_summary?records=5"))
+    assert summary["total_records"] >= 1
+    assert any(row["phase"] == "e2e" for row in summary["summary"])
+    assert summary["records"] and "phases" in summary["records"][-1]
 
 
 def test_multiprocessing_pool(ray_cluster):

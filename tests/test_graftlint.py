@@ -591,6 +591,47 @@ def test_event_schema_accepts_canonical_records(tmp_path):
     assert "event-record-schema" not in rules_in(findings)
 
 
+def test_event_schema_flags_noncanonical_phase_stamp(tmp_path):
+    """Flight-recorder stamp sites must use the task_events.PHASES
+    vocabulary — a typo'd phase drops out of every duration/histogram/
+    timeline join silently."""
+    findings = lint_file(
+        tmp_path,
+        "core/stamps.py",
+        """
+        import time
+
+        def run(spec, ph):
+            ph["worker_deque"] = time.time()      # typo'd phase
+            spec.phases["dispached"] = time.time()  # typo'd phase
+        """,
+    )
+    assert sum(1 for f in findings if f.rule_name == "event-record-schema") == 2
+
+
+def test_event_schema_flags_bad_stamp_call_and_accepts_canonical(tmp_path):
+    findings = lint_file(
+        tmp_path,
+        "core/stamps.py",
+        """
+        import time
+        from ray_tpu._private import task_events
+
+        def run(spec, ph, other):
+            task_events.stamp(ph, "not_a_phase")
+            ph["worker_dequeue"] = time.time()
+            ph["exec_start"] = ph["arg_fetch_end"] = time.time()
+            spec.phases["head_enqueue"] = time.time()
+            task_events.stamp(ph, "put_end")
+            dyn = "computed"
+            task_events.stamp(ph, dyn)   # non-literal: skipped
+            other["anything"] = 1        # not a stamp dict: skipped
+        """,
+    )
+    got = [f for f in findings if f.rule_name == "event-record-schema"]
+    assert len(got) == 1 and "not_a_phase" in got[0].message
+
+
 # --------------------------------------------------------------------- GL009
 
 

@@ -84,6 +84,42 @@ def test_strict_spread_across_nodes(cluster):
     assert nodes[0] != nodes[1]
 
 
+def test_raylet_metrics_scrape_includes_app_metrics(cluster):
+    """A raylet's /metrics endpoint serves the cluster's app metrics —
+    including the flight-recorder phase histograms — pulled from the head
+    in one prefix-ranged KV round trip, plus its own node stats."""
+    import urllib.request
+
+    ray_tpu.init(address=cluster.address)
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+
+    @ray_tpu.remote(resources={"special": 1.0})
+    def remote_work():
+        return 1
+
+    assert ray_tpu.get(remote_work.remote(), timeout=120) == 1
+    raylet_nodes = [
+        n for n in ray_tpu.nodes()
+        if n["Labels"].get("node_type") != "head" and n["Labels"].get("metrics_addr")
+    ]
+    assert raylet_nodes, f"raylet advertises no metrics_addr: {ray_tpu.nodes()}"
+    addr = raylet_nodes[0]["Labels"]["metrics_addr"]
+    deadline = time.time() + 60
+    text = ""
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            if "ray_tpu_task_phase_seconds_bucket" in text:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert "node_cpu_percent{" in text
+    assert "ray_tpu_task_phase_seconds_bucket" in text, text[:2000]
+    assert "ray_tpu_task_phase_seconds_count{" in text
+
+
 def test_cross_node_object_transfer(cluster):
     """Data created on node A is consumed by a task on node B through the
     chunked transfer agents — per-node segments are distinct, so this can

@@ -1,0 +1,246 @@
+"""Flight recorder: per-phase task-lifecycle tracing from submit to result.
+
+Covers the task-event pipeline (_private/task_events.py): stamp
+propagation across driver → head → worker → head, monotonic phase
+ordering within a joined record, trace-context chaining for nested task
+graphs, per-phase timeline sub-spans, the TASK_SUMMARY surface, the
+disabled-path overhead contract, and the per-node /metrics scrape
+(phase histograms + JAX device gauges).
+"""
+
+import re
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _summary(limit=0):
+    from ray_tpu.experimental.state import summarize_tasks
+
+    return summarize_tasks(limit=limit)
+
+
+def test_flight_record_phases_monotonic_and_summary(ray_cluster):
+    """Every joined record carries the stamps in lifecycle order, and the
+    summary aggregates per-(name, phase) latency."""
+    from ray_tpu._private import task_events
+
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    assert ray_tpu.get([traced.remote(i) for i in range(8)], timeout=60) == list(
+        range(1, 9)
+    )
+    reply = _summary(limit=50)
+    records = [r for r in reply["records"] if r["name"] == "traced"]
+    assert len(records) >= 8, f"flight records missing: {reply}"
+    for rec in records:
+        stamps = task_events.ordered(rec["phases"])
+        names = [n for n, _ in stamps]
+        # the full head-path lifecycle is stamped
+        for expected in (
+            "submit",
+            "head_enqueue",
+            "dispatch",
+            "worker_dequeue",
+            "arg_fetch_start",
+            "arg_fetch_end",
+            "exec_start",
+            "exec_end",
+            "put_start",
+            "put_end",
+            "done",
+        ):
+            assert expected in names, f"{expected} missing from {names}"
+        # monotonically ordered within the record (all processes share the
+        # node's wall clock; tiny epsilon absorbs clock granularity)
+        for (pa, ta), (pb, tb) in zip(stamps, stamps[1:]):
+            assert tb >= ta - 5e-3, f"{pb}={tb} precedes {pa}={ta} in {rec}"
+        durs = rec["durations"]
+        assert set(durs) >= {"queue_wait", "arg_fetch", "exec", "put", "e2e"}
+        assert durs["e2e"] >= durs["exec"] >= 0.0
+    rows = {(r["name"], r["phase"]): r for r in reply["summary"]}
+    for phase in ("queue_wait", "arg_fetch", "exec", "put", "e2e"):
+        row = rows[("traced", phase)]
+        assert row["count"] >= 8
+        assert row["max"] >= row["p95"] >= row["p50"] >= 0.0
+
+
+def test_timeline_subspans_trace_ids_nested_graph(monkeypatch, shutdown_only):
+    """`ray-tpu timeline` export: per-phase sub-spans (queue-wait,
+    arg-fetch, exec, put) carry trace/span ids for a nested task graph,
+    chained across span_scope in the worker."""
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def inner():
+        return 1
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote(), timeout=60) == 1
+    events = ray_tpu.timeline()
+    main = {e["name"]: e for e in events if e.get("cat") == "task"}
+    assert "outer" in main and "inner" in main
+    # trace-context propagation: one trace, inner parented under outer
+    assert main["outer"]["args"]["trace_id"] == main["inner"]["args"]["trace_id"]
+    assert main["inner"]["args"]["parent_span_id"] == main["outer"]["args"]["span_id"]
+    sub = [e for e in events if e.get("cat") == "task_phase"]
+    for task in ("outer", "inner"):
+        labels = {
+            e["name"].split(":", 1)[1]
+            for e in sub
+            if e["name"].startswith(f"{task}:")
+        }
+        assert {"queue-wait", "arg-fetch", "exec", "put"} <= labels, (
+            f"{task} sub-spans missing: {labels}"
+        )
+    # sub-spans inherit the task's span context and chrome-trace fields
+    inner_exec = next(e for e in sub if e["name"] == "inner:exec")
+    assert inner_exec["ph"] == "X" and inner_exec["dur"] >= 0
+    assert inner_exec["args"]["trace_id"] == main["inner"]["args"]["trace_id"]
+    assert inner_exec["args"]["span_id"] == main["inner"]["args"]["span_id"]
+    assert inner_exec["args"]["task_id"]
+
+
+def test_chaos_event_lands_on_timeline(ray_cluster):
+    """A chaos-fired fault report (RECORD_EVENT, source=chaos — the exact
+    frame _chaos_emit sends) appears as an instant marker on the same
+    timeline as the task spans, so fault → latency-spike causality is one
+    view."""
+    from ray_tpu._private.protocol import MsgType
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    assert ray_tpu.get(work.remote(), timeout=60) == 1
+    global_worker.core_worker.request(
+        MsgType.RECORD_EVENT,
+        {
+            "severity": "WARNING",
+            "source": "chaos",
+            "message": "wire.send drop MsgType=22",
+            "fields": {"rule": "wire.send", "action": "drop"},
+        },
+    )
+    events = ray_tpu.timeline()
+    marks = [e for e in events if e.get("cat") == "event:chaos"]
+    assert marks, "chaos event missing from timeline"
+    assert marks[-1]["ph"] == "i"
+    assert "wire.send drop" in marks[-1]["name"]
+    assert any(e.get("cat") == "task" for e in events)
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+0-9.eEinfa]+$"
+)
+
+
+def test_metrics_scrape_phase_histograms_and_device_gauges(ray_cluster):
+    """Tier-1 smoke: a stock Prometheus scrape of the node's /metrics sees
+    flight-recorder histogram families (_bucket/_sum/_count) and the JAX
+    device gauges, and every sample line parses."""
+
+    @ray_tpu.remote
+    def scraped():
+        return 1
+
+    assert ray_tpu.get([scraped.remote() for _ in range(3)], timeout=60) == [1, 1, 1]
+    nodes = ray_tpu.nodes()
+    addr = nodes[0]["Labels"].get("metrics_addr")
+    assert addr, f"head node advertises no metrics_addr: {nodes}"
+    # first scrape may import jax for the device probe: retry within a window
+    deadline = time.time() + 60
+    text = ""
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            if "jax_device_count" in text and "ray_tpu_task_phase_seconds" in text:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    # node stats + phase histograms + device gauges, all in one scrape
+    assert "node_cpu_percent{" in text
+    assert "# TYPE ray_tpu_task_phase_seconds histogram" in text
+    for phase in ("queue_wait", "arg_fetch", "exec", "put", "e2e"):
+        assert f'phase="{phase}"' in text, f"{phase} histogram missing:\n{text}"
+    assert 'ray_tpu_task_phase_seconds_bucket{' in text
+    assert "le=\"+Inf\"" in text
+    assert "ray_tpu_task_phase_seconds_sum{" in text
+    assert "ray_tpu_task_phase_seconds_count{" in text
+    assert "# TYPE jax_device_count gauge" in text
+    assert re.search(r"jax_device_count\{[^}]*\} \d+", text)
+    assert "# TYPE jax_device_hbm_used_bytes gauge" in text
+    assert "# TYPE jax_device_hbm_total_bytes gauge" in text
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+
+
+def test_recording_disabled_is_one_flag_check(monkeypatch, shutdown_only):
+    """Overhead contract: with RAY_TPU_TASK_EVENTS=0 no stamp dict is ever
+    allocated (spec.phases is None — the one check every downstream site
+    gates on), no flight records join, and the timeline carries no
+    sub-spans."""
+    monkeypatch.setenv("RAY_TPU_TASK_EVENTS", "0")
+    from ray_tpu._private import task_events
+    from ray_tpu.core.core_worker import _new_phases
+
+    task_events.set_enabled(False)
+    try:
+        # submit-side: the flag short-circuits before any allocation
+        assert _new_phases() is None
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def quiet():
+            return 1
+
+        assert ray_tpu.get(quiet.remote(), timeout=60) == 1
+        reply = _summary(limit=10)
+        assert reply["total_records"] == 0 and reply["summary"] == []
+        events = ray_tpu.timeline()
+        assert any(e.get("cat") == "task" for e in events)  # exec span stays
+        assert not [e for e in events if e.get("cat") == "task_phase"]
+    finally:
+        # restore the process default (monkeypatch reverts the env var)
+        task_events.set_enabled(True)
+
+
+def test_task_events_module_contract():
+    """Unit: durations pair every phase correctly and clamp at zero; the
+    canonical vocabulary covers every duration endpoint."""
+    from ray_tpu._private import task_events as te
+
+    for a, b in te.DURATIONS.values():
+        assert a in te.PHASES and b in te.PHASES
+        assert te.PHASES.index(a) < te.PHASES.index(b)
+    ph = {}
+    te.stamp(ph, "submit")
+    te.stamp(None, "submit")  # disabled-path tolerance
+    assert "submit" in ph
+    durs = te.durations(
+        {"submit": 1.0, "done": 3.5, "exec_start": 2.0, "exec_end": 1.9}
+    )
+    assert durs["e2e"] == 2.5
+    assert durs["exec"] == 0.0  # clamped, never negative into a histogram
+    assert "queue_wait" not in durs  # missing stamps skip their phase

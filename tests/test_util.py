@@ -56,18 +56,126 @@ def test_state_api(ray_cluster):
 
 
 def test_metrics(ray_cluster):
+    import pytest as _pytest
+
     from ray_tpu.util import metrics
 
     c = metrics.Counter("test_requests", description="reqs")
     c.inc()
     c.inc(2.0)
-    g = metrics.Gauge("test_depth")
+    g = metrics.Gauge("test_depth", tag_keys=("shard",))
     g.set(7.0, tags={"shard": "a"})
     data = metrics.read_all()
     assert any(k.startswith("test_requests") and v["value"] == 3.0 for k, v in data.items())
     text = metrics.prometheus_text()
     assert "test_requests 3.0" in text
+    assert "# TYPE test_requests counter" in text
     assert 'test_depth{shard="a"} 7.0' in text
+    # declared tag_keys are a contract (reference semantics): an
+    # undeclared tag raises instead of silently forking a series
+    with _pytest.raises(ValueError):
+        g.set(1.0, tags={"not_declared": "x"})
+    with _pytest.raises(ValueError):
+        metrics.Counter("test_requests").inc(tags={"shard": "a"})
+
+
+def test_metrics_histogram_buckets(ray_cluster):
+    """Histogram tracks real bucket counts against its boundaries and
+    renders cumulative Prometheus _bucket/_sum/_count series (the reference
+    contract the seed's running-mean collapse broke)."""
+    from ray_tpu.util import metrics
+
+    h = metrics.Histogram(
+        "test_latency_seconds",
+        description="lat",
+        boundaries=[0.1, 1.0, 10.0],
+        tag_keys=("op",),
+    )
+    for v in (0.05, 0.5, 0.7, 5.0, 50.0):
+        h.observe(v, tags={"op": "read"})
+    data = metrics.read_all()
+    rec = data["test_latency_seconds:op=read"]
+    assert rec["kind"] == "histogram"
+    assert rec["buckets"] == [1, 2, 1, 1]  # (≤0.1], (0.1,1], (1,10], +Inf
+    assert rec["count"] == 5 and abs(rec["sum"] - 56.25) < 1e-9
+    text = metrics.prometheus_text()
+    assert "# TYPE test_latency_seconds histogram" in text
+    assert 'test_latency_seconds_bucket{op="read",le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{op="read",le="1.0"} 3' in text
+    assert 'test_latency_seconds_bucket{op="read",le="10.0"} 4' in text
+    assert 'test_latency_seconds_bucket{op="read",le="+Inf"} 5' in text
+    assert 'test_latency_seconds_count{op="read"} 5' in text
+    assert 'test_latency_seconds_sum{op="read"}' in text
+    # label-value escaping: quotes/backslashes/newlines can't corrupt the
+    # exposition format
+    g = metrics.Gauge("test_escape", tag_keys=("k",))
+    g.set(1.0, tags={"k": 'a"b\\c\nd'})
+    assert 'test_escape{k="a\\"b\\\\c\\nd"} 1.0' in metrics.prometheus_text()
+
+
+def test_metrics_concurrent_worker_increments_merge(ray_cluster):
+    """Counter increments from concurrent workers must all survive: each
+    process writes its own KV series (worker-id suffix) and read_all()
+    merges them — the shared-record read-modify-write lost updates."""
+    import ray_tpu
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    class Incrementer:
+        def bump(self, n):
+            from ray_tpu.util import metrics as m
+
+            c = m.Counter("test_merged_total")
+            for _ in range(n):
+                c.inc()
+            return n
+
+    a, b = Incrementer.remote(), Incrementer.remote()
+    # interleave rounds so the two workers genuinely race their writes
+    refs = []
+    for _ in range(5):
+        refs += [a.bump.remote(10), b.bump.remote(10)]
+    assert sum(ray_tpu.get(refs, timeout=120)) == 100
+    data = metrics.read_all()
+    assert data["test_merged_total:"]["value"] == 100.0
+
+    # dead-worker series retire into a durable aggregate (counters keep
+    # their totals, the per-process keys stop accumulating)
+    import time as _t
+
+    from ray_tpu._private.worker import _require_connected
+
+    ray_tpu.kill(a)
+    deadline = _t.time() + 20
+    retired = []
+    while _t.time() < deadline:
+        if metrics.read_all().get("test_merged_total:", {}).get("value") == 100.0:
+            retired = [
+                k
+                for k in _require_connected().kv_keys("metrics:test_merged_total")
+                if k.endswith(":retired")
+            ]
+            if retired:
+                break
+        _t.sleep(0.2)
+    assert metrics.read_all()["test_merged_total:"]["value"] == 100.0
+    assert retired, "dead worker's series was not folded into :retired"
+
+
+def test_metrics_merge_records_histogram_shape_mismatch():
+    """Histogram shards with disagreeing boundary shapes still merge
+    sum/count (boundary-independent) instead of silently dropping a
+    shard's observations."""
+    from ray_tpu.util import metrics
+
+    a = metrics.new_histogram_record("h", [1.0, 2.0])
+    b = metrics.new_histogram_record("h", [1.0, 2.0, 3.0])
+    metrics.observe_into(a, 0.5)
+    metrics.observe_into(b, 2.5)
+    metrics.observe_into(b, 10.0)
+    metrics.merge_records(a, b)
+    assert a["count"] == 3 and abs(a["sum"] - 13.0) < 1e-9
+    assert len(a["buckets"]) == 3  # keeps its own bucket shape
 
 
 def test_job_submission(ray_cluster):
