@@ -34,6 +34,27 @@ _PEAK = {
 }
 
 
+def _tpu_available() -> bool:
+    """Probe ``jax.devices()`` in a THROWAWAY subprocess.  A failed
+    TPU/axon backend init poisons the jax runtime of the process that
+    attempted it (and the driver must never claim the tunneled chip
+    itself), so the probe gets its own interpreter with the same env the
+    TPU worker would inherit.  rc!=0 → no usable accelerator backend."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=180,
+            ).returncode
+            == 0
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
 def _bench_config():
     return {
         "model": os.environ.get("BENCH_MODEL", "gpt2_124m"),
@@ -142,6 +163,15 @@ def main():
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK.get(gen, _PEAK["v5e"])
 
+    # No usable TPU backend → run the whole path on CPU and SAY SO in the
+    # JSON instead of dying with a raw JaxRuntimeError (the env stays
+    # changed before any in-process jax import, so the spawned worker
+    # inherits the fallback too).
+    cpu_fallback = False
+    if not _tpu_available():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        cpu_fallback = True
+
     cfg2 = None
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         # secondary row: gpt2_350m on the same chip (BASELINE config #4
@@ -185,6 +215,7 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4),
         "mfu": round(mfu, 4),
         "platform": m["platform"],
+        "backend": "cpu_fallback" if cpu_fallback else m["platform"],
         "tpu_gen": gen if on_tpu else "cpu-fallback",
         "path": "raw" if raw else "train",
         "batch": cfg_d["batch"],

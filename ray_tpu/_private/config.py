@@ -71,6 +71,10 @@ _CONFIG_DEF: Dict[str, tuple] = {
     # -- serve --
     "serve_long_poll_timeout_s": (float, 30.0, "long-poll listen timeout"),
     "serve_queue_length_response_deadline_s": (float, 0.1, "router queue probe deadline"),
+    # -- compiled actor DAGs (ray_tpu/dag/) --
+    "dag_ring_slot_min_bytes": (int, 1 << 20, "minimum slot size for a compiled-DAG shm channel ring (sized at 2x the first payload, floored here; bigger payloads overflow inline onto the carrier conn)"),
+    "dag_channel_slots": (int, 4, "slots per compiled-DAG shm channel ring (SPSC depth before the writer back-pressures)"),
+    "dag_setup_timeout_s": (float, 30.0, "per-participant deadline for DAG_SETUP/DAG_TEARDOWN negotiation (includes waiting out actor creation)"),
 }
 
 

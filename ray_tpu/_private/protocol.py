@@ -121,6 +121,15 @@ class MsgType(enum.IntEnum):
     # chaos-aware processes over the "chaos" pubsub channel
     CHAOS_CTRL = 95
 
+    # compiled actor DAGs (ray_tpu/dag/): channel setup/teardown rides the
+    # direct-call conns; DAG_PUSH is the per-step doorbell+data frame on the
+    # pre-wired channels; DAG_STEP carries a node's flight-recorder stamps
+    # to the head (fire-and-forget, only when task events are on)
+    DAG_SETUP = 96
+    DAG_TEARDOWN = 97
+    DAG_PUSH = 98
+    DAG_STEP = 99
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with
@@ -157,6 +166,19 @@ class Connection:
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
         self._write_lock = asyncio.Lock()
+        # Disable Nagle on EVERY conn, including server-accepted ones
+        # (connect() only covered the dialing side): a Nagled reply leg
+        # adds milliseconds of coalescing delay to each small control
+        # frame, which dominates ping-pong patterns like direct actor
+        # calls and compiled-DAG doorbells.
+        try:
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                import socket as _s
+
+                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        except OSError:
+            pass
 
     @classmethod
     async def connect(
@@ -188,15 +210,7 @@ class Connection:
                         f"{type(e).__name__}: {e}"
                     ) from e
                 await asyncio.sleep(min(delay, rem))
-        try:
-            sock = writer.get_extra_info("socket")
-            if sock is not None:
-                import socket as _s
-
-                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
-        except OSError:
-            pass
-        return cls(reader, writer)
+        return cls(reader, writer)  # __init__ sets TCP_NODELAY
 
     async def send(self, msg_type: int, payload: Dict[str, Any], request_id: int = 0):
         data = pack(msg_type, request_id, payload)

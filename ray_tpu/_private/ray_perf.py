@@ -80,6 +80,45 @@ def main():
         lambda: ray_tpu.get(ray_tpu.put(big)),
         results=results,
     )
+
+    # -- dispatch-overhead pair: the same 3-actor linear pipeline driven
+    # eagerly (per-call .remote() dispatch, refs flowing driver→actor)
+    # vs as a compiled DAG (pre-wired channels, resident executors —
+    # ray_tpu/dag/).  Identical payload, identical methods; the gap IS the
+    # per-step dispatch tax the compiled path removes from the hot loop.
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x
+
+    stages = [Stage.remote() for _ in range(3)]
+    payload = b"x" * 1024
+
+    def eager_chain():
+        ref = payload
+        for s in stages:
+            ref = s.step.remote(ref)
+        return ray_tpu.get(ref, timeout=60)
+
+    eager_chain()  # settle onto the direct-call path before timing
+    eager_rate = timeit("eager actor chain (3 stages)", eager_chain, results=results)
+
+    with InputNode() as inp:
+        out = inp
+        for s in stages:
+            out = s.step.bind(out)
+    compiled = out.compile()
+    compiled_rate = timeit(
+        "dag compiled step (3 stages)",
+        lambda: compiled.execute(payload, timeout=60),
+        results=results,
+    )
+    results["dag compiled vs eager speedup"] = compiled_rate / eager_rate
+    print(f"dag compiled vs eager speedup: {compiled_rate / eager_rate:.1f}x")
+    compiled.teardown()
+
     print(json.dumps({k: round(v, 1) for k, v in results.items()}))
     ray_tpu.shutdown()
 

@@ -28,6 +28,15 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._invoke(self._method_name, args, kwargs, self._num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Declare this method as a node in a static dataflow graph
+        (compiled actor DAGs, ray_tpu/dag/).  Args may be other bound
+        nodes, an InputNode, or plain constants; nothing executes until
+        the graph is compiled and driven with ``compiled.execute()``."""
+        from ray_tpu.dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def options(self, num_returns: int = 1, **_):
         return ActorMethod(self._handle, self._method_name, num_returns)
 

@@ -33,6 +33,9 @@ from ray_tpu._private.serialization import SerializedObject
 
 _U32 = struct.Struct("<I")
 _ALIGN = 64
+# shared zero block for create_raw_sealed: full-length slices of bytes
+# return the object itself, so only the final partial chunk ever copies
+_ZERO_CHUNK = b"\x00" * (256 * 1024)
 
 
 def _pad(n: int) -> int:
@@ -267,7 +270,13 @@ class ShmObjectStore:
             chunk = view[pos : pos + blen]
             buffers.append(memoryview(bytes(chunk)) if copy_out else chunk)
             pos = _pad(pos + blen)
-        return SerializedObject(bytes(metadata), inband, buffers)
+        sobj = SerializedObject(bytes(metadata), inband, buffers)
+        if copy_out:
+            # pre-3.12 buffers are copies, but the pin contract must not be
+            # version-dependent: a live get_serialized() result keeps the
+            # object evict-exempt either way (test_pinned_not_evicted)
+            sobj._pin = region
+        return sobj
 
     # -- raw ops (object-transfer layer) --------------------------------------
 
@@ -376,6 +385,48 @@ class ShmObjectStore:
         for i in range(max(0, n)):
             out.append((ids.raw[i * self.ID_LEN : (i + 1) * self.ID_LEN], int(sizes[i])))
         return out
+
+    def create_raw_sealed(self, object_id: bytes, size: int, init: bytes = b"") -> bool:
+        """Allocate a zero-initialized `size`-byte object, write ``init`` at
+        offset 0, and seal it in one step — the backing region for a
+        compiled-DAG channel ring (dag/channel.py), which both endpoints
+        mutate in place through pinned views for the channel's lifetime.
+        ``init`` lands BEFORE the seal, so a peer that attaches the moment
+        the object becomes visible can never observe a half-initialized
+        header.  The pins the endpoints take keep the region off the LRU.
+        Returns False if the id already exists."""
+        view = self.raw_create(object_id, size)
+        if view is None:
+            return False
+        # zero in bounded chunks: one `b"\x00" * size` temporary would
+        # transiently double a multi-MB ring's footprint per channel
+        off = 0
+        while off < size:
+            n = min(size - off, len(_ZERO_CHUNK))
+            view[off : off + n] = _ZERO_CHUNK[:n]
+            off += n
+        if init:
+            view[: len(init)] = init
+        self.raw_seal(object_id)
+        return True
+
+    def pinned_view(self, object_id: bytes):
+        """Writable zero-copy view of a sealed object plus the pin holder:
+        ``(view, region)`` or None if absent.  The caller must keep
+        ``region`` alive for as long as it touches ``view`` — dropping the
+        last reference releases the store pin (on every Python version;
+        this bypasses the PEP-688 read path, so pre-3.12 gets zero-copy
+        too).  Mutating the view is only sound for regions whose layout is
+        owned by cooperating endpoints (DAG channel rings) — sealed data
+        objects stay immutable by contract."""
+        self._check(object_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, object_id, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        region = _PinnedRegion(self, object_id, self._mv[off.value : off.value + size.value])
+        return region._view, region
 
     def raw_seal(self, object_id: bytes):
         if self._lib.store_seal(self._handle, object_id) != 0:

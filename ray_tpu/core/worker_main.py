@@ -46,6 +46,11 @@ class WorkerRuntime:
         self.cancelled: set = set()
         self._concurrency_sem: Optional[threading.Semaphore] = None
         self._direct_server = None
+        # mutual exclusion between eager actor calls and compiled-DAG
+        # executor steps (ray_tpu/dag/): a sequential actor keeps its
+        # one-call-at-a-time contract across both modes
+        self.actor_lock = threading.Lock()
+        self._dag_runtime = None  # lazy: ray_tpu.dag.executor.DagWorkerRuntime
         # per-caller sequential ordering across the head→direct transition
         # (reference analog: sequential_actor_submit_queue.cc): seq we expect
         # next per caller_id, plus held-back out-of-order specs
@@ -137,6 +142,17 @@ class WorkerRuntime:
         if payload.get("directive"):
             return  # spawn directives are raylet business, not ours
         self.task_queue.put(payload)
+
+    def dag_runtime(self):
+        """Lazy compiled-DAG runtime (ray_tpu/dag/executor.py) — created on
+        the first DAG_SETUP so workers that never join a compiled graph
+        never import the dag subsystem.  Only called from the io loop
+        (direct-server frame handlers), so no lock is needed."""
+        if self._dag_runtime is None:
+            from ray_tpu.dag.executor import DagWorkerRuntime
+
+            self._dag_runtime = DagWorkerRuntime(self)
+        return self._dag_runtime
 
     # ------------------------------------------------------------ execution
 
@@ -345,6 +361,12 @@ class WorkerRuntime:
 
                 fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), self.actor.async_loop)
                 return fut.result()
+            if self._concurrency_sem is None:
+                # sequential actor: eager calls and resident compiled-DAG
+                # steps (dag/executor.py takes the same lock) stay mutually
+                # excluded, preserving the one-call-at-a-time contract
+                with self.actor_lock:
+                    return method(*args, **kwargs)
             return method(*args, **kwargs)
         raise ValueError(f"unknown task type {spec.task_type}")
 
@@ -391,8 +413,32 @@ class WorkerRuntime:
                         self.task_queue.put(
                             {"spec": payload["spec"], "direct": (conn, rid)}
                         )
+                    elif msg_type == MsgType.DAG_PUSH:
+                        # compiled-step doorbell: O(1) enqueue to the node's
+                        # channel, the resident executor thread does the rest
+                        if self._dag_runtime is not None:
+                            self._dag_runtime.handle_push(payload)
+                    elif msg_type == MsgType.DAG_SETUP:
+                        try:
+                            reply = await self.dag_runtime().handle_setup(payload, conn)
+                        except Exception as e:  # noqa: BLE001 -- reported to the compiling driver
+                            await conn.reply(rid, {}, error=f"{type(e).__name__}: {e}")
+                        else:
+                            await conn.reply(rid, reply)
+                    elif msg_type == MsgType.DAG_TEARDOWN:
+                        if self._dag_runtime is None:
+                            await conn.reply(rid, {"ok": True, "absent": True})
+                        else:
+                            await conn.reply(
+                                rid, await self._dag_runtime.handle_teardown(payload)
+                            )
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
                 pass
+            finally:
+                # a dag dies with its driver conn: stop executors, release
+                # channels, return to eager-only service
+                if self._dag_runtime is not None:
+                    self._dag_runtime.on_conn_lost(conn)
 
         async def _start():
             server = await asyncio.start_server(_serve, "0.0.0.0", 0)

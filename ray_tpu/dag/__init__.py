@@ -1,0 +1,23 @@
+"""Compiled actor DAGs: static-dataflow execution with pre-wired channels.
+
+Declare a static call graph over existing actors with ``.bind()`` /
+``InputNode`` / ``MultiOutputNode``, then ``dag.compile()`` resolves the
+topology ONCE, pre-wires persistent SPSC channels between participants
+(shm ring slots for co-located pairs, the direct actor-call TCP conns
+cross-node), and installs a resident executor loop on each participating
+actor.  ``compiled.execute(x)`` is one channel write + one channel read at
+the driver — no head round-trip, no per-call TaskSpec, no per-call graph
+serialization (Pathways' off-the-hot-path dispatch, PAPERS.md §2, on the
+Ray actor substrate, PAPERS.md §1).
+
+See ``ray_tpu/dag/DESIGN.md`` for the API, channel wiring, and the
+error / teardown contract.
+"""
+
+from ray_tpu.dag.node import (  # noqa: F401
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.exceptions import DagExecutionError, DagInvalidatedError  # noqa: F401

@@ -135,6 +135,22 @@ class HeadUnreachableError(RaySystemError, ConnectionError):
     catching it."""
 
 
+class DagError(RayError):
+    """Base class for compiled-DAG (ray_tpu/dag/) errors."""
+
+
+class DagExecutionError(DagError):
+    """A compiled-DAG step failed at the driver: either a node raised (the
+    remote error is ``__cause__``; the graph stays valid) or a channel /
+    participant died mid-step (the graph is invalidated)."""
+
+
+class DagInvalidatedError(DagExecutionError):
+    """The compiled graph can no longer execute (severed channel, dead
+    participant, timeout desync, or teardown).  Contract: re-compile over
+    the surviving actors, or fail — invalidation is never silent."""
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

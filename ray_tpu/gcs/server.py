@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos
+from ray_tpu._private import task_events as _task_events
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.protocol import Connection, MsgType
@@ -2311,6 +2312,56 @@ class HeadServer:
             out["records"] = records[-limit:]
         return out
 
+    async def h_dag_step(self, cid, conn, p):
+        """A batch of compiled-DAG step flight records (fire-and-forget
+        DAG_STEP frame from dag/executor.py, sent only while task events
+        are on; the executor buffers ~16 steps per frame so the hot loop
+        never pays a head wakeup per step).  Compiled steps never transit
+        the scheduler, so these frames are their entire head-side
+        footprint: join each record into the flight-record ring, the
+        per-phase histograms, and the timeline — where h_timeline renders
+        per-node dag_channel_wait / dag_exec / dag_push sub-spans exactly
+        like the eager phases."""
+        from ray_tpu._private import task_events
+
+        dag_id = str(p.get("dag_id", ""))
+        node_hex = bytes(p.get("node_id") or b"").hex()
+        for step in p.get("steps", []):
+            phases = {str(k): float(v) for k, v in (step.get("phases") or {}).items()}
+            if not phases:
+                continue
+            name = f"dag:{step.get('name', 'node')}"
+            step_id = f"{dag_id}:{int(step.get('seq', 0))}"
+            durs = task_events.durations(phases)
+            self.task_records.append(
+                {
+                    "task_id": step_id,
+                    "name": name,
+                    "node_id": node_hex,
+                    "pid": int(step.get("pid", 0)),
+                    "error": bool(step.get("error")),
+                    "trace": {},
+                    "phases": phases,
+                    "durations": durs,
+                }
+            )
+            for phase, dur in durs.items():
+                self._observe_phase(phase, name, node_hex, dur)
+            exec_start = phases.get("dag_exec_start", 0.0)
+            self.timeline.append(
+                {
+                    "name": name,
+                    "pid": int(step.get("pid", 0)),
+                    "ts": exec_start,
+                    "dur": max(0.0, phases.get("dag_exec_end", exec_start) - exec_start),
+                    "error": bool(step.get("error")),
+                    "trace": {},
+                    "phases": phases,
+                    "task_id": step_id,
+                }
+            )
+        return {}
+
     def _chaos_emit(self, ev: dict):
         self._record_event("WARNING", "chaos", ev["message"], **ev["fields"])
 
@@ -2411,6 +2462,14 @@ class HeadServer:
         ("arg-fetch", "arg_fetch_start", "arg_fetch_end"),
         ("exec", "exec_start", "exec_end"),
         ("put", "put_start", "put_end"),
+        # compiled-DAG steps (DAG_STEP frames) come straight from the
+        # canonical phase vocabulary, so a dag phase added there can never
+        # silently miss the timeline — eager records lack these stamps and
+        # skip them
+    ) + tuple(
+        (name, start, end)
+        for name, (start, end) in _task_events.DURATIONS.items()
+        if name.startswith("dag_")
     )
 
     async def h_timeline(self, cid, conn, p):
@@ -2942,4 +3001,5 @@ HeadServer._HANDLERS = {
     MsgType.LIST_TASKS: HeadServer.h_list_tasks,
     MsgType.TIMELINE: HeadServer.h_timeline,
     MsgType.TASK_SUMMARY: HeadServer.h_task_summary,
+    MsgType.DAG_STEP: HeadServer.h_dag_step,
 }

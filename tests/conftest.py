@@ -33,8 +33,12 @@ def shutdown_only():
     (reference: python/ray/tests/conftest.py:194)."""
     yield None
     import ray_tpu
+    from ray_tpu._private.config import RayConfig
 
     ray_tpu.shutdown()
+    # _system_config overrides passed to init() must not leak into the
+    # next test's RayConfig view (test_substrate asserts the defaults)
+    RayConfig.reset()
 
 
 @pytest.fixture
@@ -46,3 +50,6 @@ def ray_start_regular(request):
     info = ray_tpu.init(num_cpus=4, **kwargs)
     yield info
     ray_tpu.shutdown()
+    from ray_tpu._private.config import RayConfig
+
+    RayConfig.reset()

@@ -779,3 +779,88 @@ def test_suspended_worker_declared_dead_by_heartbeat(shutdown_only):
         assert pid2 != pid1  # fresh worker hosts the restarted actor
     finally:
         chaos_api.resume_worker(pid1)
+
+
+# ============================================================ compiled DAGs
+
+
+def test_dag_channel_sever_invalidates_graph(shutdown_only):
+    """Wire plane on a compiled graph (ray_tpu/dag/): chaos severs the
+    carrier conn under a DAG_PUSH mid-step.  The failing execute raises
+    DagExecutionError, the channels drain (executor loops stop, no stuck
+    threads), every later execute raises DagInvalidatedError, and eager
+    calls on the participants still work — the re-compile-or-fail
+    contract."""
+    from ray_tpu.dag import InputNode
+    from ray_tpu.exceptions import DagExecutionError, DagInvalidatedError
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x
+
+        def dag_threads(self):
+            import threading
+
+            return [
+                t.name for t in threading.enumerate() if t.name.startswith("dag-exec")
+            ]
+
+    a = Stage.remote()
+    with InputNode() as inp:
+        compiled = a.step.bind(inp).compile()
+    assert compiled.execute(b"ok", timeout=60) == b"ok"
+
+    # co-located steps ride the shm ring, so put the fault on the path a
+    # DAG_PUSH frame actually takes: an oversized payload overflows the
+    # ring slot and ships inline on the carrier conn — sever THAT send
+    chaos.arm("driver:wire.send.sever@DAG_PUSH#1=1.0", seed=3)
+    big = b"y" * (3 << 20)  # 3MB > the ring slot sized by the first step
+    with pytest.raises(DagExecutionError):
+        compiled.execute(big, timeout=60)
+    chaos.disarm()
+    with pytest.raises(DagInvalidatedError):
+        compiled.execute(b"again", timeout=60)
+    assert compiled.invalidated is not None
+    # channels drained: the executor loop exited and released its end
+    deadline = time.time() + 30
+    while ray_tpu.get(a.dag_threads.remote(), timeout=60):
+        assert time.time() < deadline, "executor threads survived the sever"
+        time.sleep(0.2)
+    # the actor itself is healthy and back on normal eager service
+    assert ray_tpu.get(a.step.remote(7), timeout=60) == 7
+    compiled.teardown()
+
+
+def test_dag_participant_death_invalidates_graph(shutdown_only):
+    """Process plane on a compiled graph: chaos-kill one participant's
+    worker.  The graph invalidates (typed, never silent) while eager
+    calls on the SURVIVING participants keep working."""
+    from ray_tpu.dag import InputNode
+    from ray_tpu.exceptions import DagExecutionError, DagInvalidatedError
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    a, b = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        compiled = b.step.bind(a.step.bind(inp)).compile()
+    assert compiled.execute(0, timeout=60) == 2
+
+    chaos_api.kill_worker(a)
+    # the dead participant's carrier conn drops → the blocked execute (or
+    # the next one) surfaces the invalidation as a typed error
+    with pytest.raises((DagExecutionError, DagInvalidatedError)):
+        compiled.execute(0, timeout=30)
+    with pytest.raises(DagInvalidatedError):
+        compiled.execute(0, timeout=30)
+    # the surviving actor still serves eager calls
+    assert ray_tpu.get(b.step.remote(10), timeout=60) == 11
+    compiled.teardown()
