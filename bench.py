@@ -127,6 +127,38 @@ def _run_steps(cfg_d):
     final_loss = float(metrics["loss"])  # forces the whole step chain
     dt = time.perf_counter() - t0
 
+    # probed pass AFTER the timed loop: per-step breakdown + jitter via
+    # the flight recorder's StepProbe (train/jax/step_probe.py) without
+    # perturbing the headline async-dispatch throughput above (the probe
+    # brackets compute with a sync point by design)
+    probe_steps = max(4, steps // 4)
+    jitter = {}
+    try:
+        from ray_tpu.train.jax import StepProbe
+
+        probe = StepProbe(
+            "bench_gpt2",
+            flops_per_step=cfg.flops_per_token() * batch * seq,
+        )
+        for _ in range(probe_steps):
+            with probe.step():
+                with probe.phase("compute"):
+                    params, opt_state, metrics = bundle.step(
+                        params, opt_state, tokens, targets
+                    )
+                    probe.block(metrics)
+                with probe.phase("metrics_fold"):
+                    float(metrics["loss"])
+        probe.flush()
+        st = probe.stats()
+        jitter = {
+            "probed_step_ms_p50": round(st.get("p50_s", 0) * 1e3, 2),
+            "probed_step_ms_p99": round(st.get("p99_s", 0) * 1e3, 2),
+            "step_jitter_pct": round(st.get("jitter_pct", 0), 2),
+        }
+    except Exception as e:  # noqa: BLE001 — the headline number stands alone
+        jitter = {"probe_error": str(e)[:200]}
+
     return {
         "platform": devices[0].platform,
         "tokens_per_sec": batch * seq * steps / dt,
@@ -134,6 +166,7 @@ def _run_steps(cfg_d):
         "step_ms": 1000 * dt / steps,
         "seq": seq,
         "loss": final_loss,
+        **jitter,
     }
 
 

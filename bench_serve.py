@@ -91,6 +91,21 @@ def main():
             }
         )
 
+    # TTFT/TPOT from the serve request-trace plane: the replica stamps
+    # prefill/first-token/decode boundaries per request (serve/tracing.py),
+    # the head joins them next to the task flight records, and the summary
+    # reports the percentiles — the baseline the continuous-batching
+    # engine (ROADMAP item 1) has to beat.
+    ttft = tpot = {}
+    try:
+        from ray_tpu.experimental.state import summarize_workloads
+
+        serve_summary = summarize_workloads("serve")
+        ttft = serve_summary.get("ttft", {}).get("llm") or {}
+        tpot = serve_summary.get("tpot", {}).get("llm") or {}
+    except Exception as e:  # noqa: BLE001 — bench must still emit a row
+        print(f"serve-trace summary unavailable: {e}")
+
     result = {
         "metric": "serve_llama_decode_tokens_per_sec_per_chip",
         "value": max(r["tokens_per_sec"] for r in rows),
@@ -105,6 +120,10 @@ def main():
         "batching": {"max_batch_size": MAX_BATCH, "batch_wait_timeout_s": 0.02},
         "autoscaling_engaged": True,
         "compile_s": round(compile_s, 1),
+        "ttft_ms_p50": round(ttft["p50"] * 1e3, 1) if ttft else None,
+        "ttft_ms_p99": round(ttft["p99"] * 1e3, 1) if ttft else None,
+        "tpot_ms_p50": round(tpot["p50"] * 1e3, 2) if tpot else None,
+        "tpot_ms_p99": round(tpot["p99"] * 1e3, 2) if tpot else None,
         "loads": rows,
     }
     with open("SERVE_BENCH_r05.json", "w") as f:

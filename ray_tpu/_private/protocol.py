@@ -130,6 +130,14 @@ class MsgType(enum.IntEnum):
     DAG_PUSH = 98
     DAG_STEP = 99
 
+    # workload-plane flight records (fire-and-forget, batched, sent only
+    # while task events are on): serve request traces from replicas
+    # (serve/tracing.py) and train-step records from StepProbe
+    # (train/jax/step_probe.py) — the head joins both next to the task
+    # flight records
+    SERVE_TRACE = 100
+    TRAIN_STEP = 101
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

@@ -63,6 +63,37 @@ PHASES = (
     "dag_exec_start",  # executor: bound method entered
     "dag_exec_end",
     "dag_push_end",  # executor: result handed to every consumer channel
+    # -- serve request lifecycle (ray_tpu/serve/tracing.py) --------------
+    # A serve request is its own sub-lifecycle: the ingress (HTTP proxy or
+    # a bare DeploymentHandle) stamps the front, the replica stamps the
+    # back, and the completed record ships to the head on a SERVE_TRACE
+    # frame.  The LLM path additionally splits model time at the first
+    # token (prefill/decode boundary) — the stamps TTFT/TPOT derive from.
+    "serve_proxy_recv",  # ingress: request received (proxy or handle)
+    "serve_route",  # ingress: deployment resolved, replica picked
+    "serve_replica_recv",  # replica: handle_request entered
+    "serve_queue_enter",  # replica: request joined the batch queue
+    "serve_queue_exit",  # replica: released into a batch
+    "serve_batch_assembled",  # replica: padded tensor batch built
+    "serve_prefill_start",  # replica: prefill program dispatched
+    "serve_first_token",  # replica: first token's logits ready (TTFT end)
+    "serve_decode_end",  # replica: last token decoded
+    "serve_handler_end",  # replica: handler returned (record sealed)
+    # -- train step lifecycle (ray_tpu/train/jax/step_probe.py) ----------
+    # One record per training step, stamped entirely by the training
+    # process (clock-skew-immune by construction) and shipped batched on
+    # TRAIN_STEP frames.  `compute` brackets the jitted step with
+    # block_until_ready so async dispatch can't hide device time.
+    "train_step_start",
+    "train_data_wait_start",  # input pipeline: waiting on the next batch
+    "train_data_wait_end",
+    "train_h2d_start",  # host→device transfer of the batch
+    "train_h2d_end",
+    "train_compute_start",  # jitted step dispatch → block_until_ready
+    "train_compute_end",
+    "train_metrics_fold_start",  # host-side metrics/scalar extraction
+    "train_metrics_fold_end",
+    "train_step_end",
 )
 
 # Derived per-phase durations: name -> (start stamp, end stamp).
@@ -83,6 +114,25 @@ DURATIONS = {
     "dag_channel_wait": ("dag_channel_wait_start", "dag_channel_wait_end"),
     "dag_exec": ("dag_exec_start", "dag_exec_end"),
     "dag_push": ("dag_exec_end", "dag_push_end"),
+    # serve request stages: route/deliver cross processes (ingress →
+    # replica, ±NTP skew off-host); everything from replica_recv on pairs
+    # stamps from the replica process.  Eager/task records lack these
+    # stamps and skip them.
+    "serve_route": ("serve_proxy_recv", "serve_route"),
+    "serve_deliver": ("serve_route", "serve_replica_recv"),
+    "serve_queue_wait": ("serve_queue_enter", "serve_queue_exit"),
+    "serve_batch_assemble": ("serve_queue_exit", "serve_batch_assembled"),
+    "serve_prefill": ("serve_prefill_start", "serve_first_token"),
+    "serve_decode": ("serve_first_token", "serve_decode_end"),
+    "serve_handler": ("serve_replica_recv", "serve_handler_end"),
+    "serve_e2e": ("serve_proxy_recv", "serve_handler_end"),
+    # train step phases: all stamped by ONE process (the trainer), so
+    # every pair is clock-skew-immune by construction.
+    "train_data_wait": ("train_data_wait_start", "train_data_wait_end"),
+    "train_h2d": ("train_h2d_start", "train_h2d_end"),
+    "train_compute": ("train_compute_start", "train_compute_end"),
+    "train_metrics_fold": ("train_metrics_fold_start", "train_metrics_fold_end"),
+    "train_step": ("train_step_start", "train_step_end"),
 }
 
 # Histogram boundaries for the per-phase latency metrics (seconds).  Wide
@@ -97,6 +147,40 @@ PHASE_METRIC_HELP = (
     "Per-phase task lifecycle latency (flight recorder), tagged by "
     "phase/name/node"
 )
+
+# ---- serve request plane (ray_tpu/serve/tracing.py → head join) --------
+# Finer boundaries than the task phases: a routed request on a warm
+# replica turns around in hundreds of microseconds, while a cold LLM
+# batch can take tens of seconds.
+SERVE_HISTOGRAM_BOUNDARIES = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+SERVE_METRIC = "ray_tpu_serve_request_seconds"
+SERVE_METRIC_HELP = (
+    "Per-stage serve request latency (proxy→route→queue→batch→prefill→"
+    "decode), tagged by stage/deployment"
+)
+SERVE_TTFT_METRIC = "ray_tpu_serve_ttft_seconds"
+SERVE_TTFT_HELP = "Time from request receipt to the first generated token"
+SERVE_TPOT_METRIC = "ray_tpu_serve_tpot_seconds"
+SERVE_TPOT_HELP = "Mean per-token decode time after the first token"
+# TPOT sits orders of magnitude under request latency
+TPOT_HISTOGRAM_BOUNDARIES = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0,
+)
+
+# ---- train step plane (ray_tpu/train/jax/step_probe.py → head join) ----
+TRAIN_METRIC = "ray_tpu_train_step_seconds"
+TRAIN_METRIC_HELP = (
+    "Per-phase training step latency (data_wait/h2d/compute/metrics_fold/"
+    "step), tagged by phase/name"
+)
+TRAIN_JITTER_METRIC = "ray_tpu_train_step_jitter_pct"
+TRAIN_JITTER_HELP = "Rolling step-time jitter: (p99 - p50) / p50 * 100"
+TRAIN_MFU_METRIC = "ray_tpu_train_mfu"
+TRAIN_MFU_HELP = "Model FLOPs utilization over the rolling step window"
 
 # THE flag: stamp sites check this module attribute directly
 # (`if task_events.enabled: ...`) so the disabled hot path costs one

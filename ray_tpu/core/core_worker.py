@@ -464,7 +464,12 @@ class CoreWorker:
         # objects for the container's lifetime (borrower protocol)
         self.request(
             MsgType.PUT_OBJECT,
-            {"object_id": oid, "node_id": self.node_id, "contained": sobj.contained},
+            {
+                "object_id": oid,
+                "node_id": self.node_id,
+                "contained": sobj.contained,
+                "nbytes": sobj.total_bytes(),
+            },
         )
 
     def _promote_memory_objects(self, oids: Sequence[bytes], _async: bool = False):
@@ -516,6 +521,7 @@ class CoreWorker:
                 "object_id": oid,
                 "node_id": self.node_id,
                 "contained": sobj.contained,
+                "nbytes": sobj.total_bytes(),
             }
             if _async:
                 self.io.spawn(self._ship_promotion(MsgType.PUT_OBJECT, payload))

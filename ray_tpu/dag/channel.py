@@ -337,6 +337,20 @@ class ChannelReader:
     def push(self, payload: dict) -> None:
         self._q.put(payload)
 
+    def occupancy(self) -> Optional[Tuple[int, int]]:
+        """(unconsumed steps, slot capacity) for a ring-backed channel —
+        one header unpack of shared memory, sampled by the executor's
+        DAG_STEP flush for the head's memory accounting.  None for
+        inline/cross-node channels (their depth is the io queue's)."""
+        ring = self._ring
+        if ring is None or ring._view is None:
+            return None
+        try:
+            w, r = ring._seqs()
+        except (ChannelBrokenError, struct.error):
+            return None
+        return max(0, w - r), ring.nslots
+
     def wake_broken(self, reason: str) -> None:
         self._q.put({"__broken__": reason})
 

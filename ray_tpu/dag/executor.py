@@ -369,6 +369,17 @@ class DagWorkerRuntime:
             self._ship_steps(dag, batch)
 
     def _ship_steps(self, dag: "_DagInstance", batch: List[dict]) -> None:
+        # ring occupancy samples ride the batch (one header unpack per
+        # channel per flush — the memory accounting plane costs the hot
+        # loop nothing extra)
+        channels = []
+        for node in dag.nodes:
+            for reader in node.readers:
+                occ = reader.occupancy()
+                if occ is not None:
+                    channels.append(
+                        {"c": reader.key, "occ": occ[0], "slots": occ[1]}
+                    )
         try:
             self.cw.io.spawn(
                 self.cw.conn.send(
@@ -377,6 +388,7 @@ class DagWorkerRuntime:
                         "dag_id": dag.dag_id,
                         "node_id": self.cw.node_id,
                         "steps": batch,
+                        "channels": channels,
                     },
                 )
             )

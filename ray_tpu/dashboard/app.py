@@ -65,14 +65,26 @@ class DashboardServer:
 
         async def api_task_summary(request):
             """Flight-recorder per-phase latency summary (p50/p95/max per
-            task name); ?records=N appends the N most recent raw records."""
-            from ray_tpu.experimental.state import summarize_tasks
+            task name); ?records=N appends the N most recent raw records;
+            ?what=serve|train|memory selects a workload plane."""
+            from ray_tpu.experimental.state import summarize_workloads
 
             try:
                 limit = int(request.query.get("records", 0))
             except ValueError:
                 limit = 0
-            return _json(summarize_tasks(limit=limit))
+            what = request.query.get("what", "tasks")
+            try:
+                return _json(summarize_workloads(what, limit=limit))
+            except Exception as e:  # noqa: BLE001 — unknown kind etc.
+                return web.json_response({"error": str(e)}, status=400)
+
+        async def api_slo(request):
+            """SLO watchdog verdicts + declared specs (the policy surface
+            autoscaling/preemption will consume)."""
+            from ray_tpu.experimental.state import slo_status
+
+            return _json(slo_status())
 
         async def api_events(request):
             from ray_tpu.experimental.state.api import list_cluster_events
@@ -143,6 +155,7 @@ class DashboardServer:
             <a href=/api/pgs>pgs</a> <a href=/api/metrics>metrics</a>
             <a href=/api/timeline>timeline</a>
             <a href=/api/task_summary>task_summary</a>
+            <a href=/api/slo>slo</a>
             <a href=/api/events>events</a>
             <a href=/api/objects>objects</a></p>
             </body></html>"""
@@ -158,6 +171,7 @@ class DashboardServer:
         app.router.add_get("/api/metrics", api_metrics)
         app.router.add_get("/api/timeline", api_timeline)
         app.router.add_get("/api/task_summary", api_task_summary)
+        app.router.add_get("/api/slo", api_slo)
         app.router.add_get("/api/events", api_events)
         app.router.add_get("/api/objects", api_objects)
         app.router.add_get("/api/serve/applications", api_serve_get)
@@ -166,6 +180,9 @@ class DashboardServer:
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", self.port)
         await site.start()
+        # report the BOUND port, not the requested one — port 0 means
+        # "ephemeral" and the configured value would be a dead URL
+        self.port = site._server.sockets[0].getsockname()[1]
         return f"http://127.0.0.1:{self.port}"
 
 

@@ -5,5 +5,7 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
     list_objects,
     list_placement_groups,
     list_tasks,
+    slo_status,
     summarize_tasks,
+    summarize_workloads,
 )

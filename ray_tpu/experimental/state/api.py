@@ -83,6 +83,20 @@ def summarize_tasks(limit: int = 0) -> Dict:
     return _cw().request(MsgType.TASK_SUMMARY, {"limit": limit})
 
 
+def summarize_workloads(what: str = "tasks", limit: int = 0) -> Dict:
+    """Workload-plane summaries from the head: "tasks" (the flight
+    recorder), "serve" (per-deployment stage latencies + TTFT/TPOT),
+    "train" (step breakdown + jitter/MFU), "memory" (per-node shm
+    occupancy, object accounting, DAG ring occupancy), "slo" (the
+    watchdog's verdicts)."""
+    return _cw().request(MsgType.TASK_SUMMARY, {"what": what, "limit": limit})
+
+
+def slo_status() -> Dict:
+    """The SLO watchdog's latest verdicts (+ the declared specs)."""
+    return summarize_workloads("slo")
+
+
 def list_cluster_events(limit: int = 1000) -> List[dict]:
     """Structured lifecycle events: node/actor/worker transitions, OOM
     kills, spill passes (reference analog: src/ray/util/event.h + the

@@ -46,6 +46,22 @@ logger = logging.getLogger("ray_tpu.gcs")
 # Object table states (analog: reference object directory + task states)
 PENDING, SEALED, ERRORED = 0, 1, 2
 
+
+def _percentiles(vals: List[float]) -> dict:
+    """Nearest-rank percentile row shared by every summary surface."""
+    vals = sorted(vals)
+    n = len(vals)
+    if n == 0:
+        return {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0, "max": 0.0, "mean": 0.0}
+    return {
+        "count": n,
+        "p50": vals[int(0.50 * (n - 1))],
+        "p95": vals[int(0.95 * (n - 1))],
+        "p99": vals[int(0.99 * (n - 1))],
+        "max": vals[-1],
+        "mean": sum(vals) / n,
+    }
+
 # Actor FSM states (reference: gcs_actor_manager.cc state machine)
 ACTOR_PENDING, ACTOR_ALIVE, ACTOR_RESTARTING, ACTOR_DEAD = (
     "PENDING_CREATION",
@@ -103,6 +119,7 @@ class NodeInfo:
         "labels",
         "address",
         "transfer_addr",
+        "store_stats",
         "_sched",
     )
 
@@ -124,6 +141,9 @@ class NodeInfo:
         self.labels: Dict[str, str] = {}
         self.address = ""
         self.transfer_addr = ""
+        # freshest shm-store occupancy reported on this node's heartbeat
+        # (the head's own node is sampled directly by the observer loop)
+        self.store_stats: Dict[str, float] = {}
         self._sched = sched
         if sched is not None:
             sched.upsert_node(node_id, self.resources_total)
@@ -320,6 +340,19 @@ class HeadServer:
         # parsed histogram records cached by kv key: one json.dumps per
         # observe instead of a loads+dumps round trip on the done path
         self._phase_hist_cache: Dict[str, dict] = {}
+        # workload-plane observability (serve/train/memory + SLO watchdog)
+        # object accounting sidecar: oid -> {"nbytes", "owner"} stamped at
+        # seal time (owner derived from the sealing connection)
+        self.object_meta: Dict[bytes, dict] = {}
+        # freshest rolling stats per train run (TRAIN_STEP frames)
+        self.train_stats: Dict[str, dict] = {}
+        # freshest DAG channel ring occupancy samples (DAG_STEP frames)
+        self.dag_channel_stats: Dict[str, dict] = {}
+        # SLO watchdog: spec blob cache + one evaluator and verdict per slo
+        self._slo_specs_blob: Optional[bytes] = None
+        self._slo_specs: List[dict] = []
+        self._slo_evals: Dict[str, object] = {}
+        self._slo_state: Dict[str, dict] = {}
 
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
@@ -439,11 +472,24 @@ class HeadServer:
         # entries that point at THIS head's (ephemeral) store segment
         self._wal("head", self.head_node_id)
 
+        # SLO specs can be seeded from the environment (operators without a
+        # driver attached yet); a later slo_api.set_slos replaces them
+        env_specs = os.environ.get("RAY_TPU_SLO_SPECS", "").strip()
+        if env_specs and "slo:specs" not in self.kv:
+            try:
+                from ray_tpu._private import slo as slo_mod
+
+                slo_mod.parse_specs(env_specs)
+                self.kv["slo:specs"] = env_specs.encode()
+            except (ValueError, TypeError) as e:
+                logger.warning("RAY_TPU_SLO_SPECS rejected: %s", e)
+
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._idle_reaper_loop())
         asyncio.get_running_loop().create_task(self._failure_detector_loop())
         asyncio.get_running_loop().create_task(self._persist_loop())
         asyncio.get_running_loop().create_task(self._memory_monitor_loop())
+        asyncio.get_running_loop().create_task(self._workload_observer_loop())
         logger.info("head server listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -815,6 +861,15 @@ class HeadServer:
 
     async def h_heartbeat(self, cid, conn, p):
         self._last_beat[cid] = time.time()
+        # raylet beats piggyback their node's shm-store occupancy so the
+        # head can aggregate cluster memory without an extra RPC plane
+        store = p.get("store")
+        if store and p.get("node_id") is not None:
+            node = self.nodes.get(bytes(p["node_id"]))
+            if node is not None:
+                node.store_stats = {
+                    str(k): float(v) for k, v in store.items()
+                }
         return {"ok": True, "t": time.time()}
 
     async def _failure_detector_loop(self):
@@ -1116,9 +1171,22 @@ class HeadServer:
         if nid is None:
             nid = self._conn_node.get(cid) or self.head_node_id
         self._pin_contained(bytes(p["object_id"]), p.get("contained") or [])
+        self._record_object_meta(cid, bytes(p["object_id"]), p.get("nbytes"))
         self._add_location(p["object_id"], nid)
         await self._seal_object(p["object_id"])
         return {"ok": True}
+
+    def _record_object_meta(self, cid: int, oid: bytes, nbytes) -> None:
+        """Object-accounting sidecar for `ray-tpu summary memory`: who
+        sealed it (derived from the sealing connection — workers by id,
+        drivers/clients by kind) and how big it was on the wire."""
+        wid = self._conn_worker.get(cid)
+        owner = (
+            bytes(wid).hex()[:12]
+            if wid
+            else (self._conn_kind.get(cid) or "head")
+        )
+        self.object_meta[oid] = {"owner": owner, "nbytes": int(nbytes or 0)}
 
     def _pin_contained(self, oid: bytes, contained: List[bytes]):
         """Pin the refs pickled inside a stored object for the container's
@@ -1521,6 +1589,7 @@ class HeadServer:
     async def h_free_object(self, cid, conn, p):
         for oid in p["object_ids"]:
             self.objects.pop(oid, None)
+            self.object_meta.pop(bytes(oid), None)
             self._delete_everywhere(oid)
             self._release_contained(bytes(oid))
         return {"ok": True}
@@ -1554,6 +1623,7 @@ class HeadServer:
             self.object_refcounts.pop(oid, None)
             # out of scope everywhere → evictable; delete eagerly
             self.objects.pop(oid, None)
+            self.object_meta.pop(oid, None)
             self._delete_everywhere(oid)
             # nobody can ever get() it again → its lineage is dead too
             self._drop_lineage(oid)
@@ -2254,39 +2324,75 @@ class HeadServer:
         return phases
 
     def _observe_phase(self, phase: str, name: str, node_hex: str, dur: float):
-        """Fold one phase duration into the cluster-wide per-phase
-        histograms, written through to self.kv under metrics:* so the
-        normal scrape surfaces (util/metrics.read_all, per-node /metrics)
-        pick them up like any app metric.  Deliberately NOT WAL-persisted
-        (direct kv mutation, like chaos:plan): latency history dies with
-        the head incarnation."""
+        """Fold one task-phase duration into the flight-recorder
+        histograms (see _observe_hist for the write-through contract)."""
+        from ray_tpu._private import task_events
+
+        self._observe_hist(
+            task_events.PHASE_METRIC,
+            task_events.PHASE_METRIC_HELP,
+            task_events.PHASE_HISTOGRAM_BOUNDARIES,
+            {"phase": phase, "name": name, "node": node_hex[:12]},
+            dur,
+        )
+
+    def _observe_hist(self, metric, help_text, boundaries, tags, value):
+        """Fold one observation into a head-owned histogram series,
+        written through to self.kv under metrics:* so the normal scrape
+        surfaces (util/metrics.read_all, per-node /metrics) pick it up
+        like any app metric.  Deliberately NOT WAL-persisted (direct kv
+        mutation, like chaos:plan): latency history dies with the head
+        incarnation."""
         import json as _json
 
-        from ray_tpu._private import task_events
         from ray_tpu.util import metrics as metrics_mod
 
-        tags = {"phase": phase, "name": name, "node": node_hex[:12]}
-        key = (
-            f"metrics:{task_events.PHASE_METRIC}:"
-            f"{metrics_mod.tag_string(tags)}:head"
-        )
+        key = f"metrics:{metric}:{metrics_mod.tag_string(tags)}:head"
         rec = self._phase_hist_cache.get(key)
         if rec is None:
-            rec = metrics_mod.new_histogram_record(
-                task_events.PHASE_METRIC_HELP,
-                task_events.PHASE_HISTOGRAM_BOUNDARIES,
-            )
+            rec = metrics_mod.new_histogram_record(help_text, boundaries)
             rec["tags"] = tags
             self._phase_hist_cache[key] = rec
-        metrics_mod.observe_into(rec, dur)
+        metrics_mod.observe_into(rec, value)
+        self.kv[key] = _json.dumps(rec).encode()
+
+    def _set_gauge(self, metric, help_text, tags, value):
+        """Head-owned gauge series, same write-through as _observe_hist."""
+        import json as _json
+
+        from ray_tpu.util import metrics as metrics_mod
+
+        key = f"metrics:{metric}:{metrics_mod.tag_string(tags)}:head"
+        rec = {
+            "kind": "gauge",
+            "value": float(value),
+            "ts": time.time(),
+            "description": help_text,
+            "tags": tags,
+        }
         self.kv[key] = _json.dumps(rec).encode()
 
     async def h_task_summary(self, cid, conn, p):
-        """Per-phase latency summary (p50/p95/max) over the joined flight
-        records, grouped by task name — the backend of `ray-tpu summary
-        tasks` and the dashboard's /api/task_summary (reference analog:
-        `ray summary tasks`, state/state_cli.py)."""
+        """Workload summaries over the joined flight records.  `what`
+        selects the plane: "tasks" (default — per-phase latency table,
+        the backend of `ray-tpu summary tasks` / /api/task_summary),
+        "serve" (per-deployment stage latencies + TTFT/TPOT), "train"
+        (per-run step breakdown + jitter/MFU), "memory" (per-node store
+        occupancy, object accounting, DAG ring occupancy, spill
+        counters), "slo" (the watchdog's verdicts).  Reference analog:
+        `ray summary tasks`, state/state_cli.py."""
+        what = str(p.get("what", "tasks"))
         limit = int(p.get("limit", 0))
+        if what == "serve":
+            return self._summary_serve(limit)
+        if what == "train":
+            return self._summary_train(limit)
+        if what == "memory":
+            return self._summary_memory()
+        if what == "slo":
+            return self._summary_slo()
+        if what != "tasks":
+            raise ValueError(f"unknown summary kind {what!r}")
         records = list(self.task_records)
         groups: Dict[Tuple[str, str], List[float]] = {}
         for rec in records:
@@ -2294,23 +2400,114 @@ class HeadServer:
                 groups.setdefault((rec["name"], phase), []).append(dur)
         summary = []
         for (name, phase), vals in sorted(groups.items()):
-            vals.sort()
-            n = len(vals)
-            summary.append(
-                {
-                    "name": name,
-                    "phase": phase,
-                    "count": n,
-                    "p50": vals[int(0.50 * (n - 1))],
-                    "p95": vals[int(0.95 * (n - 1))],
-                    "max": vals[-1],
-                    "mean": sum(vals) / n,
-                }
-            )
+            summary.append({"name": name, "phase": phase, **_percentiles(vals)})
         out = {"summary": summary, "total_records": len(records)}
         if limit > 0:
             out["records"] = records[-limit:]
         return out
+
+    def _summary_serve(self, limit: int = 0) -> dict:
+        """Per-(deployment, stage) latency table plus TTFT/TPOT
+        percentiles, aggregated over the serve flight records."""
+        records = [
+            r for r in self.task_records if r["name"].startswith("serve:")
+        ]
+        stages: Dict[Tuple[str, str], List[float]] = {}
+        ttft: Dict[str, List[float]] = {}
+        tpot: Dict[str, List[float]] = {}
+        for rec in records:
+            dep = rec["name"][len("serve:"):]
+            for phase, dur in rec["durations"].items():
+                stages.setdefault((dep, phase), []).append(dur)
+            if rec.get("ttft_s") is not None:
+                ttft.setdefault(dep, []).append(float(rec["ttft_s"]))
+            if rec.get("tpot_s") is not None:
+                tpot.setdefault(dep, []).append(float(rec["tpot_s"]))
+        summary = [
+            {"deployment": dep, "stage": stage, **_percentiles(vals)}
+            for (dep, stage), vals in sorted(stages.items())
+        ]
+        out = {
+            "summary": summary,
+            "ttft": {d: _percentiles(v) for d, v in ttft.items()},
+            "tpot": {d: _percentiles(v) for d, v in tpot.items()},
+            "total_records": len(records),
+        }
+        if limit > 0:
+            out["records"] = records[-limit:]
+        return out
+
+    def _summary_train(self, limit: int = 0) -> dict:
+        """Per-run step breakdown (phase percentiles over the record
+        ring) plus the freshest rolling stats each probe shipped
+        (jitter/MFU over ITS window, which outlives the ring)."""
+        records = [
+            r for r in self.task_records if r["name"].startswith("train:")
+        ]
+        groups: Dict[Tuple[str, str], List[float]] = {}
+        for rec in records:
+            run = rec["name"][len("train:"):]
+            for phase, dur in rec["durations"].items():
+                groups.setdefault((run, phase), []).append(dur)
+        summary = [
+            {"run": run, "phase": phase, **_percentiles(vals)}
+            for (run, phase), vals in sorted(groups.items())
+        ]
+        out = {
+            "summary": summary,
+            "runs": {k: dict(v) for k, v in self.train_stats.items()},
+            "total_records": len(records),
+        }
+        if limit > 0:
+            out["records"] = records[-limit:]
+        return out
+
+    def _summary_memory(self) -> dict:
+        """Cluster memory accounting: per-node shm occupancy, the object
+        directory by state/owner, spill counters, DAG ring occupancy."""
+        nodes = {}
+        for nid, node in self.nodes.items():
+            stats = dict(node.store_stats)
+            if nid == self.head_node_id and getattr(self, "_store", None):
+                stats = {
+                    "used": float(self._store.used()),
+                    "capacity": float(self._store.capacity()),
+                    "objects": float(self._store.num_objects()),
+                    "evictions": float(self._store.evictions()),
+                }
+            nodes[nid.hex()] = {"alive": node.alive, **stats}
+        by_state: Dict[str, int] = {"SEALED": 0, "PENDING": 0, "ERRORED": 0}
+        for entry in self.objects.values():
+            key = {PENDING: "PENDING", SEALED: "SEALED", ERRORED: "ERRORED"}[entry[0]]
+            by_state[key] += 1
+        by_owner: Dict[str, dict] = {}
+        for oid, meta in self.object_meta.items():
+            if oid not in self.objects:
+                continue
+            slot = by_owner.setdefault(
+                meta.get("owner", "?"), {"count": 0, "bytes": 0}
+            )
+            slot["count"] += 1
+            slot["bytes"] += int(meta.get("nbytes", 0))
+        pinned = sum(1 for c in self.object_refcounts.values() if c > 0)
+        return {
+            "nodes": nodes,
+            "objects": {
+                "by_state": by_state,
+                "by_owner": by_owner,
+                "pinned": pinned,
+                "total": len(self.objects),
+                "spilled": len(self.object_spilled),
+                "lineage": len(self.lineage),
+            },
+            "dag_channels": {k: dict(v) for k, v in self.dag_channel_stats.items()},
+        }
+
+    def _summary_slo(self) -> dict:
+        return {
+            "slos": [dict(v) for v in self._slo_state.values()],
+            "specs": [dict(s) for s in self._slo_specs],
+        }
 
     async def h_dag_step(self, cid, conn, p):
         """A batch of compiled-DAG step flight records (fire-and-forget
@@ -2360,6 +2557,183 @@ class HeadServer:
                     "task_id": step_id,
                 }
             )
+        # ring occupancy samples piggyback the step batch (sampled at
+        # flush time, ~16 steps apart — no extra frames on the hot loop)
+        now = time.time()
+        for ch in p.get("channels", []):
+            key = str(ch.get("c", ""))
+            if not key:
+                continue
+            stat = {
+                "occupancy": int(ch.get("occ", 0)),
+                "slots": int(ch.get("slots", 0)),
+                "dag_id": dag_id,
+                "ts": now,
+            }
+            self.dag_channel_stats[key] = stat
+            self._set_gauge(
+                "ray_tpu_dag_channel_occupancy",
+                "Ring slots holding unconsumed steps (sampled per "
+                "DAG_STEP flush)",
+                {"channel": key},
+                stat["occupancy"],
+            )
+            self._set_gauge(
+                "ray_tpu_dag_channel_slots",
+                "Ring capacity in slots",
+                {"channel": key},
+                stat["slots"],
+            )
+        return {}
+
+    async def h_serve_trace(self, cid, conn, p):
+        """A batch of serve request flight records (fire-and-forget
+        SERVE_TRACE frame from serve/tracing.py, sent only while task
+        events are on).  Joined exactly like task/dag records: the
+        flight-record ring (name ``serve:<deployment>``), per-stage
+        `ray_tpu_serve_request_seconds{stage,deployment}` histograms,
+        first-class TTFT/TPOT distributions, and timeline sub-spans."""
+        from ray_tpu._private import task_events
+
+        node_hex = bytes(p.get("node_id") or b"").hex()
+        for req in p.get("requests", []):
+            phases = {str(k): float(v) for k, v in (req.get("phases") or {}).items()}
+            if not phases:
+                continue
+            dep = str(req.get("deployment") or "deployment")
+            name = f"serve:{dep}"
+            durs = task_events.durations(phases)
+            rec = {
+                "task_id": "",
+                "name": name,
+                "node_id": node_hex,
+                "pid": int(req.get("pid", 0)),
+                "error": bool(req.get("error")),
+                "trace": {
+                    str(k): str(v) for k, v in (req.get("trace") or {}).items()
+                },
+                "phases": phases,
+                "durations": durs,
+                "ttft_s": req.get("ttft_s"),
+                "tpot_s": req.get("tpot_s"),
+                "tokens": int(req.get("tokens") or 0),
+            }
+            self.task_records.append(rec)
+            for stage, dur in durs.items():
+                if not stage.startswith("serve_"):
+                    continue
+                self._observe_hist(
+                    task_events.SERVE_METRIC,
+                    task_events.SERVE_METRIC_HELP,
+                    task_events.SERVE_HISTOGRAM_BOUNDARIES,
+                    {"stage": stage, "deployment": dep},
+                    dur,
+                )
+            if rec["ttft_s"] is not None:
+                self._observe_hist(
+                    task_events.SERVE_TTFT_METRIC,
+                    task_events.SERVE_TTFT_HELP,
+                    task_events.SERVE_HISTOGRAM_BOUNDARIES,
+                    {"deployment": dep},
+                    float(rec["ttft_s"]),
+                )
+            if rec["tpot_s"] is not None:
+                self._observe_hist(
+                    task_events.SERVE_TPOT_METRIC,
+                    task_events.SERVE_TPOT_HELP,
+                    task_events.TPOT_HISTOGRAM_BOUNDARIES,
+                    {"deployment": dep},
+                    float(rec["tpot_s"]),
+                )
+            start = phases.get("serve_replica_recv") or phases.get("serve_proxy_recv", 0.0)
+            end = phases.get("serve_handler_end", start)
+            self.timeline.append(
+                {
+                    "name": name,
+                    "pid": rec["pid"],
+                    "ts": start,
+                    "dur": max(0.0, end - start),
+                    "error": rec["error"],
+                    "trace": rec["trace"],
+                    "phases": phases,
+                    "task_id": "",
+                }
+            )
+        return {}
+
+    async def h_train_step(self, cid, conn, p):
+        """A batch of train-step flight records plus the probe's rolling
+        stats (fire-and-forget TRAIN_STEP frame from
+        train/jax/step_probe.py).  Steps join the ring/timeline/
+        histograms; the rolling stats become the jitter/MFU gauges the
+        SLO watchdog and `ray-tpu summary train` read."""
+        from ray_tpu._private import task_events
+
+        node_hex = bytes(p.get("node_id") or b"").hex()
+        run = str(p.get("name") or "train")
+        name = f"train:{run}"
+        for step in p.get("steps", []):
+            phases = {str(k): float(v) for k, v in (step.get("phases") or {}).items()}
+            if not phases:
+                continue
+            durs = task_events.durations(phases)
+            self.task_records.append(
+                {
+                    "task_id": f"{run}:{int(step.get('seq', 0))}",
+                    "name": name,
+                    "node_id": node_hex,
+                    "pid": int(step.get("pid", 0)),
+                    "error": False,
+                    "trace": {},
+                    "phases": phases,
+                    "durations": durs,
+                }
+            )
+            for phase, dur in durs.items():
+                if not phase.startswith("train_"):
+                    continue
+                self._observe_hist(
+                    task_events.TRAIN_METRIC,
+                    task_events.TRAIN_METRIC_HELP,
+                    task_events.PHASE_HISTOGRAM_BOUNDARIES,
+                    {"phase": phase, "name": run},
+                    dur,
+                )
+            step_start = phases.get("train_step_start", 0.0)
+            self.timeline.append(
+                {
+                    "name": name,
+                    "pid": int(step.get("pid", 0)),
+                    "ts": step_start,
+                    "dur": max(
+                        0.0, phases.get("train_step_end", step_start) - step_start
+                    ),
+                    "error": False,
+                    "trace": {},
+                    "phases": phases,
+                    "task_id": f"{run}:{int(step.get('seq', 0))}",
+                }
+            )
+        stats = p.get("stats") or {}
+        if stats:
+            stats = {str(k): v for k, v in stats.items()}
+            stats["node"] = node_hex[:12]
+            stats["ts"] = time.time()
+            self.train_stats[run] = stats
+            if "jitter_pct" in stats:
+                self._set_gauge(
+                    task_events.TRAIN_JITTER_METRIC,
+                    task_events.TRAIN_JITTER_HELP,
+                    {"name": run},
+                    float(stats["jitter_pct"]),
+                )
+            if "mfu" in stats:
+                self._set_gauge(
+                    task_events.TRAIN_MFU_METRIC,
+                    task_events.TRAIN_MFU_HELP,
+                    {"name": run},
+                    float(stats["mfu"]),
+                )
         return {}
 
     def _chaos_emit(self, ev: dict):
@@ -2462,14 +2836,14 @@ class HeadServer:
         ("arg-fetch", "arg_fetch_start", "arg_fetch_end"),
         ("exec", "exec_start", "exec_end"),
         ("put", "put_start", "put_end"),
-        # compiled-DAG steps (DAG_STEP frames) come straight from the
-        # canonical phase vocabulary, so a dag phase added there can never
-        # silently miss the timeline — eager records lack these stamps and
+        # compiled-DAG / serve-request / train-step records come straight
+        # from the canonical phase vocabulary, so a phase added there can
+        # never silently miss the timeline — records without the stamps
         # skip them
     ) + tuple(
         (name, start, end)
         for name, (start, end) in _task_events.DURATIONS.items()
-        if name.startswith("dag_")
+        if name.startswith(("dag_", "serve_", "train_"))
     )
 
     async def h_timeline(self, cid, conn, p):
@@ -2933,6 +3307,195 @@ class HeadServer:
             except OSError:
                 pass
 
+    # ------------------------------------------- workload observer / SLOs
+
+    _OBSERVER_PERIOD_S = 2.0
+
+    async def _workload_observer_loop(self):
+        """The workload-plane watchdog: every tick it (a) refreshes the
+        cluster memory gauges (shm occupancy per node, object directory
+        accounting, spill counters) and (b) evaluates the declared SLOs
+        over rolling windows of the head's aggregated histograms.  SLO
+        breaches land in the cluster-event ring (source ``slo`` — instant
+        markers on the chrome timeline next to chaos events) and export
+        ray_tpu_slo_ok / ray_tpu_slo_burn_rate gauges — the policy signal
+        ROADMAP item 5's preemption/autoscaling consumes."""
+        while not self._shutdown:
+            await asyncio.sleep(self._OBSERVER_PERIOD_S)
+            try:
+                self._refresh_memory_gauges()
+                self._evaluate_slos()
+            except Exception:  # noqa: BLE001
+                logger.exception("workload observer tick failed")
+
+    # drop DAG channel samples this long after their last DAG_STEP flush:
+    # channel keys embed a per-compile random id and the head never sees
+    # DAG_TEARDOWN (it rides the direct-call conns), so without an age-out
+    # every compile would leak a stats entry + two gauge series forever
+    # and dead DAGs would scrape as live occupancy
+    _DAG_CHANNEL_TTL_S = 60.0
+
+    def _expire_dag_channel_stats(self):
+        from ray_tpu.util import metrics as metrics_mod
+
+        now = time.time()
+        for key, stat in list(self.dag_channel_stats.items()):
+            if now - float(stat.get("ts", 0.0)) <= self._DAG_CHANNEL_TTL_S:
+                continue
+            self.dag_channel_stats.pop(key, None)
+            tag_str = metrics_mod.tag_string({"channel": key})
+            self.kv.pop(
+                f"metrics:ray_tpu_dag_channel_occupancy:{tag_str}:head", None
+            )
+            self.kv.pop(
+                f"metrics:ray_tpu_dag_channel_slots:{tag_str}:head", None
+            )
+
+    def _refresh_memory_gauges(self):
+        self._expire_dag_channel_stats()
+        for nid, node in self.nodes.items():
+            if not node.alive:
+                continue
+            stats = node.store_stats
+            if nid == self.head_node_id and getattr(self, "_store", None):
+                stats = {
+                    "used": float(self._store.used()),
+                    "capacity": float(self._store.capacity()),
+                    "objects": float(self._store.num_objects()),
+                    "evictions": float(self._store.evictions()),
+                }
+            if not stats:
+                continue
+            tags = {"node": nid.hex()[:12]}
+            self._set_gauge(
+                "ray_tpu_shm_used_bytes",
+                "Bytes allocated in the node's shm object store",
+                tags,
+                stats.get("used", 0),
+            )
+            self._set_gauge(
+                "ray_tpu_shm_capacity_bytes",
+                "Capacity of the node's shm object store",
+                tags,
+                stats.get("capacity", 0),
+            )
+            self._set_gauge(
+                "ray_tpu_shm_objects",
+                "Objects resident in the node's shm store",
+                tags,
+                stats.get("objects", 0),
+            )
+            self._set_gauge(
+                "ray_tpu_shm_evictions_total",
+                "LRU evictions since the node's store was created",
+                tags,
+                stats.get("evictions", 0),
+            )
+        by_state = {"SEALED": 0, "PENDING": 0, "ERRORED": 0}
+        for entry in self.objects.values():
+            by_state[
+                {PENDING: "PENDING", SEALED: "SEALED", ERRORED: "ERRORED"}[entry[0]]
+            ] += 1
+        for state, count in by_state.items():
+            self._set_gauge(
+                "ray_tpu_object_count",
+                "Objects in the head directory by state",
+                {"state": state},
+                count,
+            )
+        self._set_gauge(
+            "ray_tpu_object_pinned_count",
+            "Objects with a positive cluster refcount",
+            {},
+            sum(1 for c in self.object_refcounts.values() if c > 0),
+        )
+        self._set_gauge(
+            "ray_tpu_objects_spilled",
+            "Objects whose only durable copy is a spill file",
+            {},
+            len(self.object_spilled),
+        )
+
+    def _slo_metrics_view(self) -> Dict[str, dict]:
+        """read_all()-shaped merged metrics with a "name" key per record
+        (what SloEvaluator matches on)."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        merged = metrics_mod.merge_series(
+            metrics_mod.raw_records_from_kv(self.kv)
+        )
+        for key, rec in merged.items():
+            rec["name"], _, _ = metrics_mod.parse_series_key(key)
+        return merged
+
+    def _evaluate_slos(self):
+        import json as _json
+
+        from ray_tpu._private import slo as slo_mod
+
+        blob = self.kv.get("slo:specs")
+        if blob != self._slo_specs_blob:
+            self._slo_specs_blob = blob
+            try:
+                self._slo_specs = slo_mod.parse_specs(blob or b"[]")
+            except (ValueError, TypeError) as e:
+                logger.warning("invalid slo:specs ignored: %s", e)
+                self._slo_specs = []
+            live = {s["name"] for s in self._slo_specs}
+            self._slo_evals = {
+                name: ev for name, ev in self._slo_evals.items() if name in live
+            }
+            self._slo_state = {
+                name: st for name, st in self._slo_state.items() if name in live
+            }
+        if not self._slo_specs:
+            return
+        merged = self._slo_metrics_view()
+        now = time.time()
+        for spec in self._slo_specs:
+            name = spec["name"]
+            ev = self._slo_evals.get(name)
+            if ev is None or ev.spec != spec:
+                # new or changed spec: fresh evaluator (fresh window)
+                ev = slo_mod.SloEvaluator(spec)
+                self._slo_evals[name] = ev
+            verdict = ev.evaluate(merged, now)
+            prev_ok = self._slo_state.get(name, {}).get("ok", True)
+            self._slo_state[name] = verdict
+            self._set_gauge(
+                "ray_tpu_slo_ok",
+                "1 while the SLO holds over its rolling window",
+                {"slo": name},
+                1.0 if verdict["ok"] else 0.0,
+            )
+            self._set_gauge(
+                "ray_tpu_slo_burn_rate",
+                "Error-budget burn rate (1.0 consumes the budget exactly)",
+                {"slo": name},
+                float(verdict.get("burn_rate") or 0.0),
+            )
+            if prev_ok and not verdict["ok"]:
+                self._record_event(
+                    "WARNING",
+                    "slo",
+                    f"SLO breach: {name} "
+                    f"value={verdict.get('value')} "
+                    f"threshold={verdict.get('threshold')} "
+                    f"burn_rate={verdict.get('burn_rate'):.2f}",
+                    slo=name,
+                    value=verdict.get("value"),
+                    threshold=verdict.get("threshold"),
+                    burn_rate=verdict.get("burn_rate"),
+                )
+            elif not prev_ok and verdict["ok"]:
+                self._record_event(
+                    "INFO",
+                    "slo",
+                    f"SLO recovered: {name}",
+                    slo=name,
+                    value=verdict.get("value"),
+                )
+
     async def _idle_reaper_loop(self):
         while not self._shutdown:
             await asyncio.sleep(5.0)
@@ -3002,4 +3565,6 @@ HeadServer._HANDLERS = {
     MsgType.TIMELINE: HeadServer.h_timeline,
     MsgType.TASK_SUMMARY: HeadServer.h_task_summary,
     MsgType.DAG_STEP: HeadServer.h_dag_step,
+    MsgType.SERVE_TRACE: HeadServer.h_serve_trace,
+    MsgType.TRAIN_STEP: HeadServer.h_train_step,
 }

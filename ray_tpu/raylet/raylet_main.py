@@ -226,7 +226,22 @@ class Raylet:
         try:
             while True:
                 await asyncio.sleep(period)
-                await conn.send(MsgType.HEARTBEAT, {"node_id": self.node_id.binary()})
+                beat = {"node_id": self.node_id.binary()}
+                # piggyback this node's shm occupancy so the head's memory
+                # accounting (`ray-tpu summary memory`, ray_tpu_shm_*
+                # gauges) covers every node without a second RPC plane
+                store = self.store
+                if store is not None:
+                    try:
+                        beat["store"] = {
+                            "used": store.used(),
+                            "capacity": store.capacity(),
+                            "objects": store.num_objects(),
+                            "evictions": store.evictions(),
+                        }
+                    except OSError:
+                        pass  # store mid-teardown: plain beat still goes
+                await conn.send(MsgType.HEARTBEAT, beat)
         except (ConnectionError, OSError):
             pass
 

@@ -156,31 +156,127 @@ def cmd_timeline(args):
     return 0
 
 
-def cmd_summary(args):
-    """`ray-tpu summary tasks`: per-phase latency table (p50/p95/max per
-    task name) from the head's flight recorder."""
-    if args.what != "tasks":
-        print(f"unknown summary kind {args.what!r} (supported: tasks)", file=sys.stderr)
-        return 1
-    import ray_tpu  # noqa: F401  (init side effect)
-    from ray_tpu.experimental.state import summarize_tasks
-
-    ray_tpu.init(address=_read_address(args))
-    reply = summarize_tasks()
-    rows = reply.get("summary", [])
-    if not rows:
-        print("no flight records yet (is RAY_TPU_TASK_EVENTS=0, or no tasks run?)")
-        return 0
-    hdr = f"{'task':28s} {'phase':12s} {'count':>7s} {'p50':>10s} {'p95':>10s} {'max':>10s}"
+def _latency_table(rows, key_a, key_b, label_a, label_b):
+    hdr = (
+        f"{label_a:28s} {label_b:20s} {'count':>7s} {'p50':>10s} "
+        f"{'p95':>10s} {'p99':>10s} {'max':>10s}"
+    )
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
         print(
-            f"{r['name'][:28]:28s} {r['phase']:12s} {r['count']:7d} "
-            f"{r['p50'] * 1e3:9.2f}ms {r['p95'] * 1e3:9.2f}ms {r['max'] * 1e3:9.2f}ms"
+            f"{str(r[key_a])[:28]:28s} {str(r[key_b])[:20]:20s} {r['count']:7d} "
+            f"{r['p50'] * 1e3:9.2f}ms {r['p95'] * 1e3:9.2f}ms "
+            f"{r.get('p99', r['p95']) * 1e3:9.2f}ms {r['max'] * 1e3:9.2f}ms"
         )
+
+
+def cmd_summary(args):
+    """`ray-tpu summary tasks|serve|train|memory`: workload-plane latency
+    and occupancy tables from the head's flight recorder."""
+    import ray_tpu  # noqa: F401  (init side effect)
+    from ray_tpu.experimental.state import summarize_workloads
+
+    ray_tpu.init(address=_read_address(args))
+    reply = summarize_workloads(args.what)
+    if args.what == "memory":
+        print("== shm stores (per node) ==")
+        for nid, st in reply.get("nodes", {}).items():
+            used = st.get("used", 0)
+            cap = st.get("capacity", 0)
+            print(
+                f"  {nid[:12]} alive={st.get('alive')} "
+                f"used={used:.0f}/{cap:.0f} bytes "
+                f"objects={st.get('objects', 0):.0f} "
+                f"evictions={st.get('evictions', 0):.0f}"
+            )
+        obj = reply.get("objects", {})
+        print(
+            f"== objects == total={obj.get('total', 0)} "
+            f"pinned={obj.get('pinned', 0)} spilled={obj.get('spilled', 0)} "
+            f"lineage={obj.get('lineage', 0)} by_state={obj.get('by_state')}"
+        )
+        for owner, st in sorted(obj.get("by_owner", {}).items()):
+            print(f"  owner {owner}: {st['count']} objects, {st['bytes']} bytes")
+        chans = reply.get("dag_channels", {})
+        if chans:
+            print("== dag channels ==")
+            for key, st in sorted(chans.items()):
+                print(
+                    f"  {key[:40]:40s} occupancy={st.get('occupancy')}/"
+                    f"{st.get('slots')} slots"
+                )
+        return 0
+    rows = reply.get("summary", [])
+    if not rows:
+        print(
+            f"no {args.what} flight records yet "
+            "(is RAY_TPU_TASK_EVENTS=0, or nothing run?)"
+        )
+        return 0
+    if args.what == "serve":
+        _latency_table(rows, "deployment", "stage", "deployment", "stage")
+        for dep, p in sorted(reply.get("ttft", {}).items()):
+            print(
+                f"TTFT {dep}: p50={p['p50'] * 1e3:.1f}ms "
+                f"p99={p['p99'] * 1e3:.1f}ms (n={p['count']})"
+            )
+        for dep, p in sorted(reply.get("tpot", {}).items()):
+            print(
+                f"TPOT {dep}: p50={p['p50'] * 1e3:.2f}ms "
+                f"p99={p['p99'] * 1e3:.2f}ms (n={p['count']})"
+            )
+    elif args.what == "train":
+        _latency_table(rows, "run", "phase", "run", "phase")
+        for run, st in sorted(reply.get("runs", {}).items()):
+            mfu = st.get("mfu")
+            print(
+                f"run {run}: steps={st.get('steps', 0):.0f} "
+                f"p50={st.get('p50_s', 0) * 1e3:.1f}ms "
+                f"p99={st.get('p99_s', 0) * 1e3:.1f}ms "
+                f"jitter={st.get('jitter_pct', 0):.1f}%"
+                + (f" mfu={mfu:.3f}" if mfu is not None else "")
+            )
+    else:
+        _latency_table(rows, "name", "phase", "task", "phase")
     print(f"({reply.get('total_records', 0)} records joined at the head)")
     return 0
+
+
+def cmd_slo(args):
+    """`ray-tpu slo`: the watchdog's verdict per declared SLO."""
+    import ray_tpu
+    from ray_tpu.experimental.state import slo_status
+
+    ray_tpu.init(address=_read_address(args))
+    reply = slo_status()
+    slos = reply.get("slos", [])
+    if not slos:
+        print(
+            "no SLOs declared (ray_tpu.util.slo_api.set_slos([...]) or "
+            "RAY_TPU_SLO_SPECS)"
+        )
+        return 0
+    hdr = (
+        f"{'slo':28s} {'ok':>4s} {'value':>12s} {'threshold':>12s} "
+        f"{'burn':>8s} {'window':>8s} {'samples':>8s}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    breached = 0
+    for s in slos:
+        ok = bool(s.get("ok"))
+        breached += 0 if ok else 1
+        val = s.get("value")
+        print(
+            f"{s['name'][:28]:28s} {'OK' if ok else 'FAIL':>4s} "
+            f"{(f'{val:.4g}' if val is not None else '-'):>12s} "
+            f"{s.get('threshold', 0):>12.4g} "
+            f"{s.get('burn_rate', 0):>8.2f} "
+            f"{s.get('window_s', 0):>7.0f}s "
+            f"{s.get('samples', 0):>8d}"
+        )
+    return 1 if breached else 0
 
 
 def main():
@@ -208,10 +304,14 @@ def main():
     p.add_argument("--output", "-o", default=None)
     p.set_defaults(fn=cmd_timeline)
 
-    p = sub.add_parser("summary", help="latency summaries from the flight recorder")
-    p.add_argument("what", choices=["tasks"])
+    p = sub.add_parser("summary", help="workload summaries from the flight recorder")
+    p.add_argument("what", choices=["tasks", "serve", "train", "memory"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("slo", help="SLO watchdog verdicts (exit 1 on a breach)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("submit", help="submit a job entrypoint command")
     p.add_argument("--address", default=None)

@@ -202,6 +202,12 @@ class HTTPProxy:
         controller = _get_or_create_controller()
 
         async def handler(request):
+            from ray_tpu.serve import tracing as serve_tracing
+
+            # request record born at the ingress: serve_proxy_recv is the
+            # TTFT/e2e origin (None when recording is off — every stamp
+            # below gates on that)
+            trace = serve_tracing.new_request()
             routes = ray_tpu.get(controller.routes.remote(), timeout=10)
             path = request.path
             name = None
@@ -211,6 +217,8 @@ class HTTPProxy:
                     break
             if name is None:
                 return web.Response(status=404, text="no route")
+            if trace is not None:
+                trace["deployment"] = name
             if name not in self._handles:
                 self._handles[name] = DeploymentHandle(name, controller)
             handle = self._handles[name]
@@ -263,7 +271,10 @@ class HTTPProxy:
                 await resp.write_eof()
                 return resp
 
-            ref = handle.remote(body)
+            if trace is not None:
+                ref = handle.remote(body, _serve_trace=trace)
+            else:
+                ref = handle.remote(body)
             loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
                 None, functools.partial(ray_tpu.get, ref, timeout=120)

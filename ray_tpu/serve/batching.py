@@ -22,9 +22,15 @@ class _BatchQueue:
         self._flusher: Optional[asyncio.Task] = None
 
     async def submit(self, instance, item):
+        from ray_tpu.serve import tracing as serve_tracing
+
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self.queue.append((item, fut))
+        # capture the submitting request's trace record NOW (submit runs
+        # on the request's own context); the flusher task stamps it later
+        trace = serve_tracing.current_request()
+        serve_tracing.stamp(trace, "serve_queue_enter")
+        self.queue.append((item, fut, trace))
         if len(self.queue) >= self.max_batch_size:
             await self._flush(instance)
         elif self._flusher is None or self._flusher.done():
@@ -36,18 +42,26 @@ class _BatchQueue:
         await self._flush(instance)
 
     async def _flush(self, instance):
+        from ray_tpu.serve import tracing as serve_tracing
+
         if not self.queue:
             return
         batch, self.queue = self.queue, []
         items = [b[0] for b in batch]
         futs = [b[1] for b in batch]
+        traces = [b[2] for b in batch if b[2] is not None]
+        for tr in traces:
+            serve_tracing.stamp(tr, "serve_queue_exit")
         try:
-            if instance is not None:
-                results = self.fn(instance, items)
-            else:
-                results = self.fn(items)
-            if asyncio.iscoroutine(results):
-                results = await results
+            # batch_scope: the model invocation below stamps assembly /
+            # prefill / decode onto every coalesced request via stamp_batch
+            with serve_tracing.batch_scope(traces):
+                if instance is not None:
+                    results = self.fn(instance, items)
+                else:
+                    results = self.fn(items)
+                if asyncio.iscoroutine(results):
+                    results = await results
             if len(results) != len(items):
                 raise ValueError(
                     f"batched fn returned {len(results)} results for {len(items)} inputs"

@@ -34,20 +34,34 @@ class Replica:
     async def handle_request(self, method: str, args, kwargs):
         # async: the worker hosts this actor on an asyncio loop, so batched
         # handlers (serve/batching.py futures) and overlapping requests work
+        from ray_tpu.serve import tracing as serve_tracing
+
+        # serve request tracing: the reserved kwarg is popped BEFORE the
+        # user callable sees kwargs; replica-side stages (queue wait,
+        # batch assembly, prefill/decode) stamp through the contextvar
+        # scope.  None (recording off / old caller) costs one check.
+        trace = kwargs.pop("_serve_trace", None)
+        serve_tracing.stamp(trace, "serve_replica_recv")
         self.inflight += 1
+        err = False
         try:
             target = self.instance if method == "__call__" else getattr(self.instance, method)
             if method == "__call__" and not callable(target):
                 raise TypeError("deployment instance is not callable")
             import inspect
 
-            result = target(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = await result
+            with serve_tracing.request_scope(trace):
+                result = target(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
             self.handled += 1
             return result
+        except BaseException:
+            err = True
+            raise
         finally:
             self.inflight -= 1
+            serve_tracing.finish_request(trace, error=err)
 
     async def handle_stream_start(self, method: str, args, kwargs):
         """Start a streaming call: the target returns a (sync or async)
@@ -453,7 +467,14 @@ class ServeController:
             nid = ray_tpu.get(handle.node_id.remote(), timeout=300)
         except Exception:
             return
-        dep.setdefault("replica_nodes", {})[rname] = nid
+        # this runs on a daemon thread while the actor thread may iterate
+        # dep['replica_nodes'] (_rolling_replace's comprehension, the
+        # checkpoint walk): publish a REPLACEMENT dict instead of mutating
+        # in place — dict assignment is atomic, iterators see old or new,
+        # never "changed size during iteration"
+        nodes = dict(dep.get("replica_nodes") or {})
+        nodes[rname] = nid
+        dep["replica_nodes"] = nodes
 
     def _rolling_replace(self, name: str) -> list:
         """Spin up the new generation, wait until it answers, swap it in,

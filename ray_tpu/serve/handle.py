@@ -205,8 +205,22 @@ class DeploymentHandle:
         class _Method:
             def remote(self, *args, **kwargs):
                 from ray_tpu._private import worker as worker_mod
+                from ray_tpu.serve import tracing as serve_tracing
 
+                # serve request tracing: adopt the ingress's record (the
+                # HTTP proxy passes one) or mint one here for bare-handle
+                # callers; the replica pops the reserved kwarg before the
+                # user callable ever sees kwargs.  With recording off the
+                # trace is None and nothing is attached (one flag check).
+                trace = kwargs.pop("_serve_trace", None)
+                if trace is None:
+                    trace = serve_tracing.new_request(handle._name)
+                elif not trace.get("deployment"):
+                    trace["deployment"] = handle._name
                 idx, replica = handle._pick_replica()
+                serve_tracing.stamp(trace, "serve_route")
+                if trace is not None:
+                    kwargs = {**kwargs, "_serve_trace": trace}
                 ref = replica.handle_request.remote(method_name, args, kwargs)
                 # decrement when the result resolves — an io-loop callback,
                 # NOT a thread per request (r2 weak #6)

@@ -202,6 +202,15 @@ class ShardedLLM:
         # updates it in place instead of double-buffering the scan carry
         # (the r4 B=16 HBM cliff).
         self._generate = jax.jit(full_generate, static_argnums=(3,), donate_argnums=(1,))
+        # split pair for the traced serving path: prefill and decode as
+        # separate programs so the first token's logits are a HOST-VISIBLE
+        # boundary — what TTFT/TPOT measure (and the baseline the
+        # continuous-batching engine has to beat).  jit objects are lazy:
+        # untraced callers (dryrun, bench fused path) never compile these.
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._decode = jax.jit(
+            generate_from, static_argnums=(4,), donate_argnums=(1,)
+        )
         self._init_cache = jax.jit(
             self.model.init_cache, static_argnums=(0,), out_shardings=self.cache_sharding
         )
@@ -209,8 +218,17 @@ class ShardedLLM:
 
     # ------------------------------------------------------------------ api
 
-    def generate(self, prompts: np.ndarray, n_new: int) -> np.ndarray:
-        """prompts [B, P] int32 → generated tokens [B, n_new] (greedy)."""
+    def generate(self, prompts: np.ndarray, n_new: int, stage_cb=None) -> np.ndarray:
+        """prompts [B, P] int32 → generated tokens [B, n_new] (greedy).
+
+        ``stage_cb(phase)`` opts into the SPLIT prefill/decode pair so the
+        first token is a host-visible boundary: the callback fires with
+        ``serve_prefill_start`` / ``serve_first_token`` /
+        ``serve_decode_end`` (canonical task_events names — the serve
+        tracer's stamp_batch slots straight in).  Without it the fused
+        one-program path runs unchanged."""
+        import jax
+
         jnp = self._jnp
         prompts = np.asarray(prompts, np.int32)
         B, P_len = prompts.shape
@@ -220,8 +238,18 @@ class ShardedLLM:
             )
         cache = self._init_cache(B)
         prompt_t = jnp.asarray(prompts.T[:, :, None])  # [P, B, 1]
-        toks = self._generate(self.params, cache, prompt_t, int(n_new))
-        return np.asarray(toks)
+        if stage_cb is None:
+            toks = self._generate(self.params, cache, prompt_t, int(n_new))
+            return np.asarray(toks)
+        stage_cb("serve_prefill_start")
+        cache, logits = self._prefill(self.params, cache, prompt_t)
+        # first token's logits resident on host clock = TTFT endpoint
+        jax.block_until_ready(logits)
+        stage_cb("serve_first_token")
+        toks, cache = self._decode(self.params, cache, logits, P_len, int(n_new))
+        toks = np.asarray(toks)  # device→host sync: decode truly done
+        stage_cb("serve_decode_end")
+        return toks
 
     def param_count(self) -> int:
         import jax
@@ -301,12 +329,23 @@ def llm_deployment(
             max_batch_size=max_batch_size, batch_wait_timeout_s=batch_wait_timeout_s
         )
         async def generate(self, prompts):
+            from ray_tpu.serve import tracing as serve_tracing
+
             ids = np.asarray(
                 [[int(p) % self.engine.cfg.vocab_size] for p in prompts]
                 + [[0]] * (max_batch_size - len(prompts)),
                 np.int32,
             )
-            out = self.engine.generate(ids, new_tokens)
+            if serve_tracing.batch_active():
+                # traced batch: stamp assembly + run the split
+                # prefill/decode pair so TTFT/TPOT are real measurements
+                serve_tracing.stamp_batch("serve_batch_assembled")
+                serve_tracing.set_batch_tokens(new_tokens)
+                out = self.engine.generate(
+                    ids, new_tokens, stage_cb=serve_tracing.stamp_batch
+                )
+            else:
+                out = self.engine.generate(ids, new_tokens)
             return [out[b].tolist() for b in range(len(prompts))]
 
         async def __call__(self, prompt):

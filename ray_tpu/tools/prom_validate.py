@@ -1,0 +1,286 @@
+"""Prometheus exposition-format validator (CI gate over live scrapes).
+
+A malformed /metrics endpoint fails silently in production: Prometheus
+drops the whole scrape, dashboards flatline, and nobody notices until an
+incident.  This validator parses exposition text the way a strict
+scraper would and reports structural errors:
+
+- every sample line must parse (name, optional labels, value)
+- every family with samples must declare ``# TYPE`` BEFORE its samples,
+  and declare it exactly once
+- no duplicate series: the same (name, sorted labelset) twice is a
+  scrape error upstream
+- label values must be properly escaped (no raw newline/quote leaks)
+- histogram families must carry ``_bucket`` samples with an ``le``
+  label, include ``le="+Inf"``, have non-decreasing cumulative buckets
+  per series, and agree with ``_count``
+
+Run against a live endpoint (used by the CI job after the tier-1 suite
+boots a cluster):
+
+    python -m ray_tpu.tools.prom_validate --url http://host:port/metrics
+    python -m ray_tpu.tools.prom_validate --live   # boot a mini cluster,
+                                                   # exercise all planes,
+                                                   # scrape + validate
+
+or feed text on stdin.  Exit code 1 on any error.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)(?:\s+[0-9]+)?$"
+)
+_LABEL_RE = re.compile(
+    r'\s*(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+_VALUE_RE = re.compile(r"^[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN)$")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(raw: str) -> Optional[List[Tuple[str, str]]]:
+    """Label pairs, or None when the block doesn't fully parse."""
+    if raw is None or raw == "":
+        return []
+    out = []
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            return None
+        out.append((m.group("key"), m.group("val")))
+        pos = m.end()
+    return out
+
+
+def _family_of(name: str, typed: Dict[str, str]) -> str:
+    """Map a sample name to its family: histogram components fold into
+    the declared histogram family."""
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if typed.get(base) == "histogram":
+                return base
+    return name
+
+
+def validate(text: str) -> List[str]:
+    """All structural errors found in one exposition document."""
+    errors: List[str] = []
+    typed: Dict[str, str] = {}  # family -> declared type
+    type_line: Dict[str, int] = {}
+    seen_series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
+    samples: List[Tuple[int, str, List[Tuple[str, str]], str]] = []
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4:
+                    errors.append(f"line {lineno}: malformed TYPE line: {line!r}")
+                    continue
+                fam, kind = parts[2], parts[3].strip()
+                if fam in typed:
+                    errors.append(
+                        f"line {lineno}: duplicate # TYPE for {fam} "
+                        f"(first at line {type_line[fam]})"
+                    )
+                typed[fam] = kind
+                type_line.setdefault(fam, lineno)
+                if kind not in ("counter", "gauge", "histogram", "summary", "untyped"):
+                    errors.append(
+                        f"line {lineno}: unknown TYPE {kind!r} for {fam}"
+                    )
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels"))
+        if labels is None:
+            errors.append(
+                f"line {lineno}: unparseable/unescaped labels: {line!r}"
+            )
+            continue
+        if not _VALUE_RE.match(m.group("value")):
+            errors.append(
+                f"line {lineno}: bad sample value {m.group('value')!r}"
+            )
+            continue
+        fam = _family_of(name, typed)
+        if fam not in typed:
+            errors.append(
+                f"line {lineno}: sample {name!r} has no preceding # TYPE "
+                f"declaration for family {fam!r}"
+            )
+        key = (name, tuple(sorted(labels)))
+        if key in seen_series:
+            errors.append(
+                f"line {lineno}: duplicate series {name}{dict(labels)} "
+                f"(first at line {seen_series[key]})"
+            )
+        else:
+            seen_series[key] = lineno
+        samples.append((lineno, name, labels, m.group("value")))
+
+    errors.extend(_check_histograms(typed, samples))
+    return errors
+
+
+def _check_histograms(typed, samples) -> List[str]:
+    errors: List[str] = []
+    # (family, non-le labels) -> [(le, cumulative count, lineno)]
+    buckets: Dict[Tuple[str, tuple], List[Tuple[float, float, int]]] = {}
+    counts: Dict[Tuple[str, tuple], float] = {}
+    for lineno, name, labels, value in samples:
+        for fam, kind in typed.items():
+            if kind != "histogram":
+                continue
+            if name == fam + "_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(
+                        f"line {lineno}: {name} sample missing the le label"
+                    )
+                    continue
+                rest = tuple(sorted((k, v) for k, v in labels if k != "le"))
+                le_f = float("inf") if le == "+Inf" else float(le)
+                buckets.setdefault((fam, rest), []).append(
+                    (le_f, float(value), lineno)
+                )
+            elif name == fam + "_count":
+                rest = tuple(sorted(labels))
+                counts[(fam, rest)] = float(value)
+    for (fam, rest), series in buckets.items():
+        series.sort(key=lambda x: x[0])
+        if not series or series[-1][0] != float("inf"):
+            errors.append(
+                f"histogram {fam}{dict(rest)}: no le=\"+Inf\" bucket"
+            )
+            continue
+        prev = -1.0
+        for le, cum, lineno in series:
+            if cum < prev:
+                errors.append(
+                    f"line {lineno}: histogram {fam}{dict(rest)} bucket "
+                    f"le={le} count {cum} decreases (prev {prev})"
+                )
+            prev = cum
+        total = counts.get((fam, rest))
+        if total is not None and series[-1][1] != total:
+            errors.append(
+                f"histogram {fam}{dict(rest)}: +Inf bucket {series[-1][1]} "
+                f"!= _count {total}"
+            )
+    return errors
+
+
+def _scrape(url: str, timeout: float = 30.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _live_scrape() -> str:
+    """Boot a mini cluster, exercise every metrics plane (tasks, serve
+    trace, train probe, memory gauges, an SLO), and return the head
+    node's /metrics text."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import slo_api
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        slo_api.set_slos(
+            [
+                {
+                    "name": "task_queue_wait_p99_ms",
+                    "metric": "ray_tpu_task_phase_seconds",
+                    "tags": {"phase": "queue_wait"},
+                    "quantile": 0.99,
+                    "threshold_ms": 60000,
+                    "window_s": 60,
+                }
+            ]
+        )
+
+        @ray_tpu.remote
+        def probe_task(x):
+            return x
+
+        assert ray_tpu.get([probe_task.remote(i) for i in range(4)], timeout=60) == [
+            0, 1, 2, 3,
+        ]
+        from ray_tpu.train.jax import StepProbe
+
+        probe = StepProbe("prom_validate")
+        for _ in range(3):
+            with probe.step():
+                with probe.phase("compute"):
+                    time.sleep(0.001)
+        probe.flush()
+        # let the observer loop tick (memory + slo gauges land in kv)
+        deadline = time.time() + 20
+        addr = None
+        while time.time() < deadline:
+            nodes = ray_tpu.nodes()
+            addr = nodes[0]["Labels"].get("metrics_addr")
+            if addr:
+                text = _scrape(f"http://{addr}/metrics")
+                if "ray_tpu_slo_ok" in text and "ray_tpu_shm_used_bytes" in text:
+                    return text
+            time.sleep(1.0)
+        if not addr:
+            raise RuntimeError("head advertised no metrics_addr")
+        return _scrape(f"http://{addr}/metrics")
+    finally:
+        ray_tpu.shutdown()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="prom_validate")
+    parser.add_argument("--url", help="scrape this /metrics endpoint")
+    parser.add_argument(
+        "--live",
+        action="store_true",
+        help="boot a mini cluster, exercise all planes, scrape + validate",
+    )
+    args = parser.parse_args(argv)
+    if args.live:
+        text = _live_scrape()
+    elif args.url:
+        text = _scrape(args.url)
+    else:
+        text = sys.stdin.read()
+    errors = validate(text)
+    n_samples = sum(
+        1 for l in text.splitlines() if l.strip() and not l.startswith("#")
+    )
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}", file=sys.stderr)
+        print(
+            f"prom_validate: {len(errors)} error(s) in {n_samples} samples",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"prom_validate: OK ({n_samples} samples, 0 errors)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

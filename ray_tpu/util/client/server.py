@@ -225,6 +225,8 @@ class ClientServer:
     itself is a normal (store-mapped) driver on the cluster."""
 
     def __init__(self, head_address: str, host: str = "127.0.0.1", port: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.head_address = head_address
         self.host = host
         self.port = port
@@ -233,6 +235,15 @@ class ClientServer:
         self._thread = None
         self._started = threading.Event()
         self._error: Optional[BaseException] = None
+        # C_GET runs a blocking ray_tpu.get (timeout=None allowed) per
+        # request: on the loop's default executor (min(32, cpus+4) — 5
+        # threads on a 1-core TPU host) a handful of slow gets parks
+        # every thread and stalls ALL sessions' RPCs, put_chunk and
+        # schedule included.  Dedicated pool (mirroring HTTPProxy's
+        # _stream_executor) so gets can only starve other gets.
+        self._get_executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="client-get"
+        )
 
     # sessions share the server's single driver connection to the head
     # (ray_tpu.init in the server process); their refs/actors are
@@ -292,7 +303,10 @@ class ClientServer:
                     await conn.reply(rid, {"ok": True})
                     continue
                 if msg_type == CMsg.C_GET:
-                    # streamed reply: run blocking get+send off the loop
+                    # streamed reply: run blocking get+send off the loop,
+                    # on the DEDICATED get pool — never the default
+                    # executor the other handlers share (a few parked
+                    # timeout=None gets would wedge every session)
                     def _do_get(p=payload, r=rid):
                         try:
                             session.get(p, loop)
@@ -304,7 +318,7 @@ class ClientServer:
                                 conn.reply(r, {}, error=str(e)), loop
                             ).result(60)
 
-                    loop.run_in_executor(None, _do_get)
+                    loop.run_in_executor(self._get_executor, _do_get)
                     continue
                 handler = handlers.get(msg_type)
                 if handler is None:
@@ -332,6 +346,7 @@ class ClientServer:
     def stop(self):
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
+        self._get_executor.shutdown(wait=False)
 
 
 def main():

@@ -1,0 +1,68 @@
+"""Driver-facing SLO API: declare objectives, read the watchdog verdicts.
+
+The head's workload observer (gcs/server.py) continuously evaluates the
+declared SLOs against its aggregated histograms (see _private/slo.py for
+the spec format and window math); breaches land in the cluster-event
+ring (source ``slo`` — instant markers on the chrome timeline, next to
+chaos events) and export ``ray_tpu_slo_ok{slo}`` /
+``ray_tpu_slo_burn_rate{slo}`` gauges.  This module is the thin client:
+
+    from ray_tpu.util import slo_api
+    slo_api.set_slos([
+        {"name": "serve_p99_ms",
+         "metric": "ray_tpu_serve_request_seconds",
+         "tags": {"stage": "serve_e2e"},
+         "quantile": 0.99, "threshold_ms": 500, "window_s": 60},
+        {"name": "task_queue_wait_p99_ms",
+         "metric": "ray_tpu_task_phase_seconds",
+         "tags": {"phase": "queue_wait"},
+         "quantile": 0.99, "threshold_ms": 50, "window_s": 60},
+        {"name": "train_step_jitter_pct",
+         "gauge": "ray_tpu_train_step_jitter_pct",
+         "max": 25.0, "window_s": 60},
+    ])
+    slo_api.status()   # -> {"slos": [...verdicts...], "specs": [...]}
+
+Specs persist in the head KV (``slo:specs``), so they survive driver
+exits and reach a head restarted from its WAL.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ray_tpu._private import slo as slo_mod
+from ray_tpu._private.protocol import MsgType
+
+SPEC_KEY = "slo:specs"
+
+
+def _cw():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._require_connected()
+
+
+def set_slos(specs: List[dict]) -> List[dict]:
+    """Validate and install the SLO spec list cluster-wide (replaces any
+    previous set).  Returns the validated specs."""
+    specs = slo_mod.parse_specs(specs)
+    _cw().kv_put(SPEC_KEY, json.dumps(specs).encode())
+    return specs
+
+
+def get_slos() -> List[dict]:
+    blob = _cw().kv_get(SPEC_KEY)
+    if not blob:
+        return []
+    return slo_mod.parse_specs(bytes(blob))
+
+
+def clear_slos() -> None:
+    _cw().kv_del(SPEC_KEY)
+
+
+def status() -> Dict:
+    """The watchdog's latest verdict per SLO (TASK_SUMMARY what=slo)."""
+    return _cw().request(MsgType.TASK_SUMMARY, {"what": "slo"})
