@@ -11,6 +11,13 @@ for one 16G v5e chip in bf16 (llama2_7b bf16 weights alone are
 ~13.5 GB — 7B serving is the tp mesh story).  Reference analog:
 python/ray/serve/benchmarks + serve/batching.py:46.
 
+A second section (SERVE_BENCH_MIXED=1, default) replays one seeded
+mixed-length Poisson trace against BOTH the static @serve.batch path and
+the continuous-batching engine (ray_tpu/serve/engine/) and emits both
+rows in the same JSON — per-class p50/p99, tokens/s, and the engine's
+real TTFT/TPOT percentiles.  The legacy sweep stays untouched for
+round-over-round comparability.
+
 Writes SERVE_BENCH_r05.json and prints one JSON line.
 """
 
@@ -24,6 +31,153 @@ MAX_SEQ = 256
 NEW_TOKENS = 32
 MAX_BATCH = int(os.environ.get("SERVE_BENCH_MAX_BATCH", "8"))
 MODEL = os.environ.get("SERVE_BENCH_MODEL", "llama_3b")
+
+# ---- mixed-length Poisson workload (static vs continuous-batching engine)
+# Short + long prompts interleaved at Poisson arrivals — the head-of-line
+# blocking shape that saturated the static path in SERVE_BENCH_r04.  The
+# tiny model keeps this section cheap on any backend (the comparison is
+# about SCHEDULING, not FLOPs); set SERVE_BENCH_MIXED_MODEL to bench a
+# real config, SERVE_BENCH_MIXED=0 to skip.
+MIXED = os.environ.get("SERVE_BENCH_MIXED", "1") not in ("0", "false")
+MIXED_MODEL = os.environ.get("SERVE_BENCH_MIXED_MODEL", "tiny")
+MIXED_RPS = float(os.environ.get("SERVE_BENCH_MIXED_RPS", "72"))
+MIXED_N = int(os.environ.get("SERVE_BENCH_MIXED_N", "240"))
+# heterogeneous budgets are THE continuous-batching case: the static
+# whole-request batch decodes EVERY member to the longest budget (its
+# wire has one new_tokens), while the engine retires each sequence at
+# its own — a short request stops at 8 tokens instead of riding out 48
+MIXED_SHORT, MIXED_LONG = 4, 96  # prompt lengths
+MIXED_NEW = {"short": 8, "long": 96}  # per-class token budgets
+MIXED_LONG_FRAC = 0.25
+
+
+def _poisson_schedule(rng, n, rate):
+    """Deterministic (seeded) arrival schedule replayed identically
+    against both systems: [(t_offset, class, prompt_tokens)]."""
+    t = 0.0
+    sched = []
+    for _ in range(n):
+        t += rng.exponential(1.0 / rate)
+        if rng.random() < MIXED_LONG_FRAC:
+            cls, plen = "long", MIXED_LONG
+        else:
+            cls, plen = "short", MIXED_SHORT
+        sched.append((t, cls, [int(x) for x in rng.integers(1, 255, plen)]))
+    return sched
+
+
+def _run_mixed(ray_tpu, handle, sched, per_request_budget: bool):
+    """Replay the schedule open-loop (arrivals don't wait for
+    completions — queueing shows up as latency, exactly like production
+    traffic) and return per-class latency percentiles + useful-tokens/s.
+    ``per_request_budget``: the engine honors a budget per request; the
+    static path can't (one new_tokens per deployment) — that asymmetry
+    is the system under test, not a bench artifact."""
+    lat: dict = {}
+    inflight: dict = {}
+
+    def _reap(timeout):
+        ready, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=timeout)
+        for r in ready:
+            t_sub, c = inflight.pop(r)
+            ray_tpu.get(r, timeout=120)
+            lat.setdefault(c, []).append(time.time() - t_sub)
+
+    t0 = time.time()
+    for t_off, cls, prompt in sched:
+        while time.time() - t0 < t_off:
+            if inflight:
+                _reap(max(0.001, t_off - (time.time() - t0)))
+            else:
+                time.sleep(min(0.002, max(0.0, t_off - (time.time() - t0))))
+        if per_request_budget:
+            payload = {"prompt": prompt, "max_new_tokens": MIXED_NEW[cls]}
+        else:
+            payload = prompt
+        inflight[handle.remote(payload)] = (time.time(), cls)
+    while inflight:
+        _reap(600)
+    dt = time.time() - t0
+    useful = sum(MIXED_NEW[cls] for _, cls, _ in sched)
+    out = {"tokens_per_sec": round(useful / dt, 1)}
+    for cls, vals in lat.items():
+        ms = np.asarray(vals) * 1000
+        out[cls] = {
+            "n": len(vals),
+            "p50_ms": round(float(np.percentile(ms, 50)), 1),
+            "p99_ms": round(float(np.percentile(ms, 99)), 1),
+        }
+    return out
+
+
+def mixed_workload_bench(ray_tpu, serve):
+    """Static whole-request batching vs the continuous-batching engine on
+    one seeded mixed-length Poisson trace; one JSON blob with both."""
+    from ray_tpu.serve.llm import engine_llm_deployment, llm_deployment
+
+    budget_max = max(MIXED_NEW.values())
+    max_seq = MIXED_LONG + budget_max + 16
+    sched = _poisson_schedule(np.random.default_rng(0), MIXED_N, MIXED_RPS)
+
+    static = serve.run(
+        llm_deployment(
+            MIXED_MODEL, max_seq_len=max_seq, new_tokens=budget_max,
+            max_batch_size=4, batch_wait_timeout_s=0.01, num_tpus=0, tp=1,
+        ).options(name="llm_static_mixed").bind()
+    )
+    # warm every (batch size, padded prompt len) shape the trace can hit:
+    # batches pad to their longest member, so P ∈ {short, long} only
+    for plen in (MIXED_SHORT, MIXED_LONG):
+        for b in range(1, 5):
+            for _ in range(2):
+                ray_tpu.get(
+                    [static.remote([1] * plen) for _ in range(b)], timeout=1800
+                )
+    static_row = _run_mixed(ray_tpu, static, sched, per_request_budget=False)
+    serve.delete("llm_static_mixed")
+
+    engine = serve.run(
+        engine_llm_deployment(
+            MIXED_MODEL, max_seq_len=max_seq, new_tokens=budget_max,
+            num_slots=8, page_size=16, prefill_chunk=16, num_tpus=0, tp=1,
+        ).options(name="llm_engine_mixed").bind()
+    )
+    ray_tpu.get(engine.remote([1] * MIXED_SHORT), timeout=1800)  # warm
+    engine_row = _run_mixed(ray_tpu, engine, sched, per_request_budget=True)
+
+    # engine-side TTFT/TPOT are real per-request measurements from the
+    # serve trace plane (first token host-visible at the prefill/decode
+    # boundary)
+    ttft = tpot = {}
+    try:
+        from ray_tpu.experimental.state import summarize_workloads
+
+        s = summarize_workloads("serve")
+        ttft = s.get("ttft", {}).get("llm_engine_mixed") or {}
+        tpot = s.get("tpot", {}).get("llm_engine_mixed") or {}
+    except Exception as e:  # noqa: BLE001 — bench must still emit a row
+        print(f"mixed serve-trace summary unavailable: {e}")
+    serve.delete("llm_engine_mixed")
+
+    sp99 = static_row.get("short", {}).get("p99_ms") or 0
+    ep99 = engine_row.get("short", {}).get("p99_ms") or 0
+    return {
+        "model": MIXED_MODEL,
+        "arrival_rate_rps": MIXED_RPS,
+        "requests": MIXED_N,
+        "new_tokens": dict(MIXED_NEW),
+        "prompt_lens": {"short": MIXED_SHORT, "long": MIXED_LONG},
+        "long_fraction": MIXED_LONG_FRAC,
+        "static": static_row,
+        "engine": engine_row,
+        "engine_ttft_ms_p50": round(ttft["p50"] * 1e3, 1) if ttft else None,
+        "engine_ttft_ms_p99": round(ttft["p99"] * 1e3, 1) if ttft else None,
+        "engine_tpot_ms_p50": round(tpot["p50"] * 1e3, 2) if tpot else None,
+        "engine_tpot_ms_p99": round(tpot["p99"] * 1e3, 2) if tpot else None,
+        # the headline: short-request tail latency under long-prompt
+        # interference, engine vs static (ROADMAP item 1's p99 cliff)
+        "short_p99_ratio_engine_vs_static": round(ep99 / sp99, 3) if sp99 else None,
+    }
 
 
 def main():
@@ -126,6 +280,17 @@ def main():
         "tpot_ms_p99": round(tpot["p99"] * 1e3, 2) if tpot else None,
         "loads": rows,
     }
+    if MIXED:
+        # side-by-side static vs continuous-batching engine on one seeded
+        # mixed-length Poisson trace (old sweep above kept untouched for
+        # r01..r05 trajectory comparability)
+        try:
+            result["mixed_workload"] = mixed_workload_bench(ray_tpu, serve)
+        except Exception as e:  # noqa: BLE001 — the legacy sweep's row must still land
+            import traceback
+
+            traceback.print_exc()
+            result["mixed_workload"] = {"error": f"{type(e).__name__}: {e}"}
     with open("SERVE_BENCH_r05.json", "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

@@ -138,6 +138,13 @@ class MsgType(enum.IntEnum):
     SERVE_TRACE = 100
     TRAIN_STEP = 101
 
+    # continuous-batching engine token streams (serve/engine/transport.py):
+    # stream attach/cancel negotiation on a consumer-dialed direct-call
+    # conn; the token frames themselves ride DAG_PUSH on the pre-wired
+    # channel (co-located consumers read the shm ring, the conn is the
+    # doorbell-free carrier) — same transport contract as compiled DAGs
+    ENGINE_STREAM = 102
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

@@ -72,6 +72,8 @@ PHASES = (
     "serve_proxy_recv",  # ingress: request received (proxy or handle)
     "serve_route",  # ingress: deployment resolved, replica picked
     "serve_replica_recv",  # replica: handle_request entered
+    "serve_engine_submit",  # replica: request entered the engine's admission queue
+    "serve_engine_admit",  # engine: slot + pages granted, prefill scheduled
     "serve_queue_enter",  # replica: request joined the batch queue
     "serve_queue_exit",  # replica: released into a batch
     "serve_batch_assembled",  # replica: padded tensor batch built
@@ -120,6 +122,11 @@ DURATIONS = {
     # stamps and skip them.
     "serve_route": ("serve_proxy_recv", "serve_route"),
     "serve_deliver": ("serve_route", "serve_replica_recv"),
+    # engine admission wait: how long a request sat in the continuous-
+    # batching engine's bounded queue before a slot + pages freed up —
+    # the direct head-of-line-blocking signal (both stamps from the
+    # replica process, clock-skew-immune)
+    "serve_engine_queue": ("serve_engine_submit", "serve_engine_admit"),
     "serve_queue_wait": ("serve_queue_enter", "serve_queue_exit"),
     "serve_batch_assemble": ("serve_queue_exit", "serve_batch_assembled"),
     "serve_prefill": ("serve_prefill_start", "serve_first_token"),

@@ -151,6 +151,31 @@ class DagInvalidatedError(DagExecutionError):
     the surviving actors, or fail — invalidation is never silent."""
 
 
+class EngineOverloadedError(RayError):
+    """The continuous-batching engine's bounded admission queue is full.
+
+    Raised at SUBMIT time (never after queueing) so callers get a fast,
+    typed rejection instead of unbounded queue growth; the HTTP proxy
+    maps it to 503 with a ``Retry-After`` header — the bounded failure
+    mode the chaos/SLO layers certify against."""
+
+    def __init__(self, message: str = "engine overloaded", retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+    def __reduce__(self):
+        # keep retry_after_s across process boundaries (default reduce
+        # would replay __init__ with args=(message,) only)
+        return (EngineOverloadedError, (self.args[0], self.retry_after_s))
+
+
+class EngineStreamError(RayError):
+    """A token stream from the inference engine broke mid-flight (replica
+    died, channel severed, consumer too slow for the backpressure bound).
+    Typed so a killed replica yields an error the client can retry on —
+    never a silent hang."""
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

@@ -260,6 +260,190 @@ class LlamaModel:
             jnp.zeros(shape, cfg.compute_dtype),
         )
 
+    # ------------------------------------------------- paged decode (engine)
+    #
+    # The continuous-batching engine (ray_tpu/serve/engine/) shares ONE
+    # fixed-shape page pool across sequences of different lengths: physical
+    # KV pages [L, num_pages, page_size, KV, D] plus a per-slot page table
+    # mapping logical page -> physical page (-1 = unallocated).  Shapes
+    # depend only on (num_slots, pages_per_slot, page_size), never on any
+    # sequence's length — the jit-shape invariant that keeps a mixed-length
+    # fleet on one compiled program (engine/DESIGN.md).  This is the
+    # gather-based reference formulation of paged attention (layout follows
+    # the TPU paged-attention kernel: k_pages/v_pages pools + page_indices +
+    # lengths); a production TPU build swaps the gather for the pallas
+    # paged-attention kernel with per-page async DMA — the pool layout and
+    # page tables are already kernel-shaped.
+
+    def _paged_write(self, buf, li: int, wpage, woff, vals):
+        """Scatter one token per slot into layer ``li`` of a page pool.
+        ``wpage`` rows for inactive/unallocated slots are out of range and
+        dropped — token-sized update on the full buffer, same in-place
+        contract as decode_step's dynamic_update_slice."""
+        return buf.at[li, wpage, woff].set(vals.astype(buf.dtype), mode="drop")
+
+    def _paged_context(self, buf, li: int, gpage, goff):
+        """Gather a slot's logical context [*, T, KV, D] from layer ``li``
+        of the pool (clipped indices; invalid rows are masked by the
+        caller's valid_ctx, never read as attention inputs)."""
+        return buf[li, gpage, goff]
+
+    def _paged_attend(self, q, keys, vals, valid_ctx):
+        """Masked single-direction attention over gathered paged context.
+        q [B, S, H, D]; keys/vals [B, T, KV, D]; valid_ctx [B, S, T]."""
+        cfg = self.config
+        H, KV, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        if KV != H:
+            rep = H // KV
+            keys = jnp.repeat(keys, rep, axis=2)
+            vals = jnp.repeat(vals, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32) * (
+            D**-0.5
+        )
+        scores = jnp.where(valid_ctx[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.compute_dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+
+    def _paged_layer(self, x, lp, li, positions, pages, wpage, woff, gpage, goff, valid_ctx):
+        """One transformer layer over paged KV: write this step's K/V into
+        the pool, gather each slot's logical context, attend.  x [B, S, E]
+        (decode: B=slots,S=1; prefill chunk: B=1,S=chunk)."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        B, S, E = x.shape
+        H, KV, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        kp, vp = pages
+
+        h = _rms_norm(x, lp["attn_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        q = (h @ lp["wq"].astype(cd)).reshape(B, S, H, D)
+        k = (h @ lp["wk"].astype(cd)).reshape(B, S, KV, D)
+        v = (h @ lp["wv"].astype(cd)).reshape(B, S, KV, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        kp = self._paged_write(kp, li, wpage, woff, k.reshape(-1, KV, D))
+        vp = self._paged_write(vp, li, wpage, woff, v.reshape(-1, KV, D))
+        keys = self._paged_context(kp, li, gpage, goff)
+        vals = self._paged_context(vp, li, gpage, goff)
+        if keys.ndim == 3:  # single-slot prefill: add the batch dim
+            keys, vals = keys[None], vals[None]
+        attn = self._paged_attend(q, keys, vals, valid_ctx).reshape(B, S, E)
+        x = x + attn @ lp["wo"].astype(cd)
+
+        h = _rms_norm(x, lp["ffn_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(cd))
+        up = h @ lp["w_up"].astype(cd)
+        x = x + (gate * up) @ lp["w_down"].astype(cd)
+        return x, (kp, vp)
+
+    def _sample_greedy(self, logits):
+        """argmax with the vocab padding masked (a padded id must never
+        enter a sequence — it has no embedding semantics)."""
+        cfg = self.config
+        if cfg.padded_vocab != cfg.vocab_size:
+            pad = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+            logits = jnp.where(pad, -jnp.inf, logits)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def init_pages(self, num_pages: int, page_size: int) -> Tuple:
+        """Physical KV page pool shared by every engine slot:
+        [L, num_pages, page_size, KV, D] pair."""
+        cfg = self.config
+        shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        return (
+            jnp.zeros(shape, cfg.compute_dtype),
+            jnp.zeros(shape, cfg.compute_dtype),
+        )
+
+    def decode_step_paged(
+        self, params, pages, tables, tokens, positions, active, page_size: int
+    ):
+        """One engine iteration: decode one token for every active slot.
+
+        pages: (k_pages, v_pages) [L, NP, PS, KV, D]; tables [S, MP] int32
+        (physical page per logical page, -1 unallocated); tokens [S] int32
+        (the token each slot feeds); positions [S] int32 (cache index the
+        fed token is written at); active [S] bool.  Returns
+        (next_tokens [S] int32 — greedy, device-argmaxed so only S ints
+        cross to the host per step — and the updated pool)."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        S, MP = tables.shape
+        NP = pages[0].shape[1]
+        T = MP * page_size
+
+        x = params["tok_emb"].astype(cd)[tokens][:, None, :]  # [S, 1, E]
+        pos2 = positions[:, None]  # [S, 1]: per-slot rope positions
+        # write target: one pool row per slot; inactive or table-miss rows
+        # go out of range and are dropped by the scatter
+        wpage = jnp.take_along_axis(tables, (positions // page_size)[:, None], axis=1)[:, 0]
+        wpage = jnp.where(active & (wpage >= 0), wpage, NP)
+        woff = positions % page_size
+        # gather map: logical context index j -> (physical page, offset)
+        j = jnp.arange(T)
+        gpage = tables[:, j // page_size]  # [S, T]
+        goff = jnp.broadcast_to(j % page_size, (S, T))
+        valid_ctx = (gpage >= 0) & (j[None, :] <= positions[:, None])
+        valid_ctx = valid_ctx & active[:, None]
+        gpage = jnp.clip(gpage, 0, NP - 1)
+        valid_ctx = valid_ctx[:, None, :]  # [S, 1(q), T]
+
+        for li in range(cfg.n_layers):
+            lp = jax.tree.map(lambda p: p[li], params["layers"])
+            x, pages = self._paged_layer(
+                x, lp, li, pos2, pages, wpage, woff, gpage, goff, valid_ctx
+            )
+        x = _rms_norm(x, params["final_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        logits = (x @ params["out_head"].astype(cd))[:, 0, :]
+        return self._sample_greedy(logits), pages
+
+    def prefill_chunk_paged(
+        self, params, pages, table_row, tokens, start_pos, n_valid, page_size: int
+    ):
+        """One chunk of one slot's prompt: write positions
+        start_pos..start_pos+n_valid-1 into the pool and return the greedy
+        next token after the chunk's LAST valid position (meaningful only
+        on the final chunk — the request's first generated token).
+
+        tokens [C] int32 (tail chunks are padded; padding masked by
+        n_valid); table_row [MP] int32; start_pos / n_valid scalars.  The
+        chunk length C is static, so a prompt of any length runs as
+        ceil(P/C) calls of ONE compiled program — chunked prefill never
+        adds a shape, and in-flight decode streams wait at most one chunk
+        (engine/DESIGN.md)."""
+        cfg = self.config
+        cd = cfg.compute_dtype
+        C = tokens.shape[0]
+        (MP,) = table_row.shape
+        NP = pages[0].shape[1]
+        T = MP * page_size
+
+        pos = start_pos + jnp.arange(C)  # [C]
+        valid_q = jnp.arange(C) < n_valid
+        x = params["tok_emb"].astype(cd)[tokens][None]  # [1, C, E]
+        wpage = table_row[pos // page_size]
+        wpage = jnp.where(valid_q & (wpage >= 0), wpage, NP)
+        woff = pos % page_size
+        j = jnp.arange(T)
+        gpage = table_row[j // page_size]  # [T]
+        goff = j % page_size
+        # causal over the slot's logical context, chunk included (K/V land
+        # in the pool before the gather)
+        valid_ctx = (gpage[None, :] >= 0) & (j[None, :] <= pos[:, None])
+        valid_ctx = valid_ctx & valid_q[:, None]
+        gpage = jnp.clip(gpage, 0, NP - 1)
+        valid_ctx = valid_ctx[None]  # [1, C, T]
+
+        for li in range(cfg.n_layers):
+            lp = jax.tree.map(lambda p: p[li], params["layers"])
+            x, pages = self._paged_layer(
+                x, lp, li, pos[None, :], pages, wpage, woff, gpage, goff, valid_ctx
+            )
+        x = _rms_norm(x, params["final_norm"].astype(jnp.float32), cfg.norm_eps).astype(cd)
+        logits = (x[0] @ params["out_head"].astype(cd))  # [C, V]
+        last = jnp.clip(n_valid - 1, 0, C - 1)
+        return self._sample_greedy(logits[last]), pages
+
     def decode_step(self, params, cache, tokens, position: jax.Array):
         """One token per sequence: tokens [B, 1], position scalar index.
         Returns (logits [B, V], new_cache).  jit once, call per token —

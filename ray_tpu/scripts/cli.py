@@ -171,6 +171,28 @@ def _latency_table(rows, key_a, key_b, label_a, label_b):
         )
 
 
+def _print_engine_gauges(engine: dict) -> None:
+    """Continuous-batching engine occupancy block shared by
+    `summary serve` and `summary memory`."""
+    if not engine:
+        return
+    print("== serve engine (continuous batching) ==")
+    for dep, gauges in sorted(engine.items()):
+        slots = gauges.get("slots:active", 0)
+        total = gauges.get("slots:total", 0)
+        pages = gauges.get("kv_pages:used", 0)
+        ptotal = gauges.get("kv_pages:total", 0)
+        print(
+            f"  {dep}: slots={slots:.0f}/{total:.0f} "
+            f"(prefill={gauges.get('slots:prefill', 0):.0f} "
+            f"decode={gauges.get('slots:decode', 0):.0f}) "
+            f"kv_pages={pages:.0f}/{ptotal:.0f} "
+            f"queue={gauges.get('queue_depth', 0):.0f} "
+            f"frag={gauges.get('page_fragmentation', 0):.2f} "
+            f"tokens={gauges.get('tokens_total', 0):.0f}"
+        )
+
+
 def cmd_summary(args):
     """`ray-tpu summary tasks|serve|train|memory`: workload-plane latency
     and occupancy tables from the head's flight recorder."""
@@ -206,6 +228,7 @@ def cmd_summary(args):
                     f"  {key[:40]:40s} occupancy={st.get('occupancy')}/"
                     f"{st.get('slots')} slots"
                 )
+        _print_engine_gauges(reply.get("serve_engine", {}))
         return 0
     rows = reply.get("summary", [])
     if not rows:
@@ -226,6 +249,7 @@ def cmd_summary(args):
                 f"TPOT {dep}: p50={p['p50'] * 1e3:.2f}ms "
                 f"p99={p['p99'] * 1e3:.2f}ms (n={p['count']})"
             )
+        _print_engine_gauges(reply.get("engine", {}))
     elif args.what == "train":
         _latency_table(rows, "run", "phase", "run", "phase")
         for run, st in sorted(reply.get("runs", {}).items()):

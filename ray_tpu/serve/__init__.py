@@ -11,4 +11,5 @@ from ray_tpu.serve.api import (  # noqa: F401
     start_http_proxy,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve import engine  # noqa: F401  (continuous-batching engine)
 from ray_tpu.serve import schema  # noqa: F401

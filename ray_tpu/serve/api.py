@@ -172,6 +172,22 @@ def shutdown():
 _STREAM_END = object()
 
 
+def _overload_retry_after(exc) -> Optional[float]:
+    """If ``exc`` is (or wraps) an EngineOverloadedError, its suggested
+    Retry-After in seconds; else None.  The engine raises it at SUBMIT
+    time in the replica, so it reaches the proxy wrapped in a
+    RayTaskError whose pickled cause survives the hop."""
+    from ray_tpu.exceptions import EngineOverloadedError
+
+    seen = 0
+    while exc is not None and seen < 8:
+        if isinstance(exc, EngineOverloadedError):
+            return max(0.0, float(getattr(exc, "retry_after_s", 1.0)))
+        exc = getattr(exc, "cause", None) or exc.__cause__
+        seen += 1
+    return None
+
+
 class HTTPProxy:
     """aiohttp ingress actor, one per node (reference:
     _private/http_proxy.py:189,333 — per-node proxies behind the cluster
@@ -230,6 +246,78 @@ class HTTPProxy:
             import asyncio
             import functools
 
+            if (
+                request.query.get("stream") == "sse"
+                or "text/event-stream" in request.headers.get("Accept", "")
+            ):
+                # continuous-batching engine deployments stream tokens as
+                # Server-Sent Events: one `data:` frame per token batch,
+                # first frame before generation completes (the dag-channel
+                # token stream under handle.stream_tokens).  Admission
+                # overload sheds BEFORE the stream opens: 503 +
+                # Retry-After, the bounded failure mode.
+                from ray_tpu.exceptions import EngineStreamError
+
+                loop = asyncio.get_running_loop()
+                it = handle.stream_tokens(body)
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _STREAM_END
+
+                try:
+                    first = await loop.run_in_executor(self._stream_executor, _next)
+                except Exception as e:  # noqa: BLE001 -- status line not sent yet: map to HTTP
+                    retry = _overload_retry_after(e)
+                    if retry is not None:
+                        return web.Response(
+                            status=503,
+                            headers={"Retry-After": str(max(1, int(retry)))},
+                            text="engine admission queue full",
+                        )
+                    return web.Response(status=500, text=f"stream failed: {e}")
+                resp = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                    }
+                )
+                await resp.prepare(request)
+                try:
+                    chunk = first
+                    while chunk is not _STREAM_END:
+                        await resp.write(
+                            (f"data: {json.dumps({'t': chunk})}\n\n").encode()
+                        )
+                        chunk = await loop.run_in_executor(
+                            self._stream_executor, _next
+                        )
+                    await resp.write(b"event: done\ndata: {}\n\n")
+                except Exception as e:  # noqa: BLE001 -- headers sent: the error travels as a typed SSE event
+                    kind = (
+                        "stream_error"
+                        if isinstance(e, EngineStreamError)
+                        else type(e).__name__
+                    )
+                    try:
+                        await resp.write(
+                            (
+                                "event: error\ndata: "
+                                + json.dumps({"error": str(e), "type": kind})
+                                + "\n\n"
+                            ).encode()
+                        )
+                    except Exception:
+                        pass
+                    it.close()
+                try:
+                    await resp.write_eof()
+                except Exception:  # noqa: BLE001 -- client hung up mid-stream; nothing left to send
+                    pass
+                return resp
+
             if request.query.get("stream") == "1":
                 # generator deployments stream over HTTP as NDJSON lines
                 # (reference: serve StreamingResponse through the proxy);
@@ -276,9 +364,21 @@ class HTTPProxy:
             else:
                 ref = handle.remote(body)
             loop = asyncio.get_running_loop()
-            result = await loop.run_in_executor(
-                None, functools.partial(ray_tpu.get, ref, timeout=120)
-            )
+            try:
+                result = await loop.run_in_executor(
+                    None, functools.partial(ray_tpu.get, ref, timeout=120)
+                )
+            except Exception as e:  # noqa: BLE001 -- overload maps to 503, the rest re-raises
+                retry = _overload_retry_after(e)
+                if retry is None:
+                    raise
+                # engine admission queue full: bounded rejection instead of
+                # unbounded queueing — clients back off per Retry-After
+                return web.Response(
+                    status=503,
+                    headers={"Retry-After": str(max(1, int(retry)))},
+                    text="engine admission queue full",
+                )
             if isinstance(result, (dict, list, str, int, float, bool)) or result is None:
                 return web.json_response({"result": result})
             return web.Response(body=str(result).encode())

@@ -14,16 +14,35 @@ from typing import Any, Callable, List, Optional
 
 
 class _BatchQueue:
-    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+    def __init__(
+        self,
+        fn,
+        max_batch_size: int,
+        batch_wait_timeout_s: float,
+        max_pending: Optional[int] = None,
+    ):
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
+        self.max_pending = max_pending
         self.queue: List = []  # [(item, future)]
         self._flusher: Optional[asyncio.Task] = None
+
+    def depth(self) -> int:
+        return len(self.queue)
 
     async def submit(self, instance, item):
         from ray_tpu.serve import tracing as serve_tracing
 
+        if self.max_pending is not None and len(self.queue) >= self.max_pending:
+            # bounded failure mode for the static path too: reject at
+            # submit (the proxy's 503) instead of queueing unboundedly
+            from ray_tpu.exceptions import EngineOverloadedError
+
+            raise EngineOverloadedError(
+                f"batch queue full ({self.max_pending} waiting)",
+                retry_after_s=max(self.timeout, 0.05) * 4,
+            )
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         # capture the submitting request's trace record NOW (submit runs
@@ -75,14 +94,23 @@ class _BatchQueue:
                     fut.set_exception(e)
 
 
-def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+def batch(
+    _fn=None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+    max_pending: Optional[int] = None,
+):
     """Decorator: async method taking a single item → coalesced list calls.
 
     The wrapped function must accept a LIST of items and return a LIST of
-    results (reference semantics)."""
+    results (reference semantics).  ``max_pending`` bounds the waiting
+    queue: overflow raises EngineOverloadedError at submit (the HTTP
+    proxy maps it to 503 + Retry-After); None keeps the legacy unbounded
+    behavior."""
 
     def deco(fn):
-        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s, max_pending)
 
         @functools.wraps(fn)
         async def wrapper(self_or_item, *args):

@@ -1,0 +1,11 @@
+"""Continuous-batching LLM inference engine (iteration-level scheduling,
+paged KV cache, dag-channel token streaming).  See DESIGN.md."""
+
+from ray_tpu.exceptions import EngineOverloadedError, EngineStreamError  # noqa: F401
+from ray_tpu.serve.engine.kv_cache import PageAllocator, PagedKVCache  # noqa: F401
+from ray_tpu.serve.engine.loop import (  # noqa: F401
+    BufferSink,
+    EngineConfig,
+    InferenceEngine,
+)
+from ray_tpu.serve.engine.scheduler import EngineRequest, EngineScheduler  # noqa: F401

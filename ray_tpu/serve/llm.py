@@ -26,7 +26,46 @@ import numpy as np
 
 from ray_tpu.models.llama import LlamaConfig, LlamaModel
 
-__all__ = ["ShardedLLM", "llm_deployment"]
+__all__ = ["ShardedLLM", "llm_deployment", "engine_llm_deployment"]
+
+
+def _resolve_cfg(model, max_seq_len):
+    """LlamaConfig from a constructor name or an instance (worker-side —
+    shared by the static and engine deployment factories)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    if isinstance(model, LlamaConfig):
+        return (
+            model
+            if max_seq_len is None
+            else dataclasses.replace(model, max_seq_len=max_seq_len)
+        )
+    return getattr(LlamaConfig, model)(
+        max_seq_len=max_seq_len or 256, param_dtype=jnp.bfloat16
+    )
+
+
+def _parse_prompt_spec(spec, vocab_size: int, default_new: int):
+    """Normalize the three accepted request shapes into
+    (prompt_ids, max_new_tokens, eos_token):
+
+    - int seed       -> one-token prompt (the static path's wire shape)
+    - [ids...]       -> explicit prompt
+    - {"prompt": int|[ids...], "max_new_tokens": n, "eos_token": t}
+    """
+    max_new, eos = default_new, None
+    if isinstance(spec, dict):
+        max_new = int(spec.get("max_new_tokens") or default_new)
+        eos = spec.get("eos_token")
+        eos = None if eos is None else int(eos)
+        spec = spec.get("prompt", 0)
+    if isinstance(spec, (list, tuple)):
+        ids = [int(t) % vocab_size for t in spec]
+    else:
+        ids = [int(spec) % vocab_size]
+    return ids, max_new, eos
 
 
 def _filter_spec(spec, axis_names):
@@ -251,6 +290,47 @@ class ShardedLLM:
         stage_cb("serve_decode_end")
         return toks
 
+    def engine_programs(self, *, num_pages: int, page_size: int) -> Dict[str, Any]:
+        """The continuous-batching engine's three jitted programs over
+        THIS mesh: page-pool init, prefill chunk, decode step
+        (models/llama.py paged variants).  The pool is sharded like the
+        contiguous cache (KV heads over tp) and DONATED into every call,
+        so the engine's resident loop re-uses one in-place buffer per
+        program — and because the paged programs are shaped by pool
+        geometry only, the whole mixed-length fleet shares exactly one
+        compiled decode shape (the engine asserts this via
+        ``compile_stats``)."""
+        import functools
+
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        page_sharding = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+        repl = NamedSharding(self.mesh, P())
+        # explicit out_shardings keep the pool's NamedSharding STABLE
+        # across calls: without them the first program's output drops to
+        # an inferred sharding, which flips the next call's jit cache key
+        # — one silent recompile per program, exactly what the engine's
+        # no-recompilation contract forbids
+        step_out = (repl, (page_sharding, page_sharding))
+        return {
+            "init": jax.jit(
+                functools.partial(self.model.init_pages, num_pages, page_size),
+                out_shardings=(page_sharding, page_sharding),
+            ),
+            "prefill": jax.jit(
+                functools.partial(self.model.prefill_chunk_paged, page_size=page_size),
+                donate_argnums=(1,),
+                out_shardings=step_out,
+            ),
+            "decode": jax.jit(
+                functools.partial(self.model.decode_step_paged, page_size=page_size),
+                donate_argnums=(1,),
+                out_shardings=step_out,
+            ),
+        }
+
     def param_count(self) -> int:
         import jax
 
@@ -281,6 +361,7 @@ def llm_deployment(
     num_tpus: int = 1,
     tp: Optional[int] = None,
     autoscaling_config: Optional[dict] = None,
+    prompt_pad: Optional[int] = None,
 ):
     """Build a Serve deployment wrapping a ShardedLLM replica.
 
@@ -305,23 +386,11 @@ def llm_deployment(
     )
     class LLMDeployment:
         def __init__(self):
-            import dataclasses
-
             import jax
-            import jax.numpy as jnp
 
-            if isinstance(model, LlamaConfig):
-                # an explicit max_seq_len overrides; otherwise the
-                # instance's own value stands
-                cfg = (
-                    model
-                    if max_seq_len is None
-                    else dataclasses.replace(model, max_seq_len=max_seq_len)
-                )
-            else:
-                cfg = getattr(LlamaConfig, model)(
-                    max_seq_len=max_seq_len or 256, param_dtype=jnp.bfloat16
-                )
+            # an explicit max_seq_len overrides; otherwise the instance's
+            # own value stands
+            cfg = _resolve_cfg(model, max_seq_len)
             self.engine = ShardedLLM(cfg, tp=tp)
             self.platform = jax.devices()[0].platform
 
@@ -331,11 +400,34 @@ def llm_deployment(
         async def generate(self, prompts):
             from ray_tpu.serve import tracing as serve_tracing
 
-            ids = np.asarray(
-                [[int(p) % self.engine.cfg.vocab_size] for p in prompts]
-                + [[0]] * (max_batch_size - len(prompts)),
-                np.int32,
-            )
+            # run the EXACT batch — padding partial batches to
+            # max_batch_size with [[0]] rows decoded the padding at full
+            # cost (in a fixed-shape XLA program a "masked" row still buys
+            # every FLOP, so honesty means a smaller program, not a mask).
+            # The compile cache grows one program per distinct partial
+            # size, bounded by max_batch_size; steady-state traffic rides
+            # the full-batch program it always compiled anyway.
+            #
+            # Multi-token prompts (the mixed-length bench's wire shape)
+            # pad to the LONGEST prompt in the coalesced batch (or the
+            # fixed ``prompt_pad``, which also pins the compile shape) —
+            # whole-request batching's intrinsic cost: every short row
+            # pays the longest row's prefill AND waits out its decode.
+            # The continuous-batching engine exists to remove exactly
+            # this.
+            vocab = self.engine.cfg.vocab_size
+            rows = []
+            for p in prompts:
+                if isinstance(p, dict):
+                    p = p.get("prompt", 0)
+                if isinstance(p, (list, tuple)):
+                    rows.append([int(t) % vocab for t in p])
+                else:
+                    rows.append([int(p) % vocab])
+            P = prompt_pad or max(len(r) for r in rows)
+            ids = np.zeros((len(rows), P), np.int32)
+            for b, r in enumerate(rows):
+                ids[b, : min(len(r), P)] = r[:P]
             if serve_tracing.batch_active():
                 # traced batch: stamp assembly + run the split
                 # prefill/decode pair so TTFT/TPOT are real measurements
@@ -360,3 +452,214 @@ def llm_deployment(
             }
 
     return LLMDeployment
+
+
+def engine_llm_deployment(
+    model="llama_3b",
+    *,
+    max_seq_len: Optional[int] = None,
+    new_tokens: int = 32,
+    num_slots: int = 8,
+    page_size: int = 16,
+    num_pages: int = 0,
+    prefill_chunk: int = 32,
+    max_queue: int = 256,
+    num_tpus: int = 1,
+    tp: Optional[int] = None,
+    name: str = "llm",
+    autoscaling_config: Optional[dict] = None,
+):
+    """Continuous-batching counterpart of :func:`llm_deployment`: the
+    replica hosts a resident :class:`~ray_tpu.serve.engine.InferenceEngine`
+    (iteration-level scheduling over a paged KV cache) instead of the
+    whole-request ``@serve.batch`` path.  Requests of any prompt length
+    admit/retire per token step, tokens stream incrementally over
+    dag-channel token streams (``handle.stream_tokens`` / SSE at the
+    proxy), and a full admission queue rejects FAST with
+    ``EngineOverloadedError`` (the proxy's 503).  Accepts the same
+    prompt wire shapes as the static path plus
+    ``{"prompt": [...], "max_new_tokens": n, "eos_token": t}`` dicts."""
+    from ray_tpu import serve
+
+    @serve.deployment(
+        name=name,
+        ray_actor_options={"num_tpus": num_tpus},
+        max_concurrent_queries=max(256, max_queue),
+        autoscaling_config=autoscaling_config
+        or {
+            "min_replicas": 1,
+            "max_replicas": 1,
+            "target_num_ongoing_requests_per_replica": 64,
+        },
+    )
+    class LLMEngineDeployment:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+            cfg = _resolve_cfg(model, max_seq_len)
+            self.llm = ShardedLLM(cfg, tp=tp)
+            self.engine = InferenceEngine(
+                self.llm,
+                EngineConfig(
+                    num_slots=num_slots,
+                    page_size=page_size,
+                    max_seq_len=cfg.max_seq_len,
+                    num_pages=num_pages,
+                    prefill_chunk=prefill_chunk,
+                    max_queue=max_queue,
+                    max_new_tokens=new_tokens,
+                ),
+                deployment=name,
+            )
+            self.platform = jax.devices()[0].platform
+
+        def _submit(self, prompt, max_new_tokens=None, eos_token=None, sink=None):
+            from ray_tpu.serve import tracing as serve_tracing
+
+            ids, spec_new, spec_eos = _parse_prompt_spec(
+                prompt, self.llm.cfg.vocab_size, new_tokens
+            )
+            return self.engine.submit(
+                ids,
+                max_new_tokens if max_new_tokens is not None else spec_new,
+                eos_token=eos_token if eos_token is not None else spec_eos,
+                trace=serve_tracing.current_request(),
+                sink=sink,
+            )
+
+        async def __call__(self, prompt):
+            """Buffered (non-streaming) callers: submit and await the full
+            sequence without blocking the replica's event loop — the
+            engine thread resolves the future at retirement."""
+            import asyncio
+
+            from ray_tpu.exceptions import EngineStreamError
+
+            req = self._submit(prompt)
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+
+            def _done(sink):
+                def _fin():
+                    if fut.done():
+                        return
+                    if sink.error is not None:
+                        fut.set_exception(EngineStreamError(sink.error))
+                    else:
+                        fut.set_result(list(sink.tokens))
+
+                loop.call_soon_threadsafe(_fin)
+
+            req.sink.add_done_callback(_done)
+            return await fut
+
+        # ---- streaming: dag-channel attach with an actor-call fallback
+
+        def engine_stream_start(self, prompt, max_new_tokens=None, eos_token=None):
+            import os
+
+            from ray_tpu.serve.engine import transport
+
+            st = transport.hub().create(
+                outbox_limit=self.engine.cfg.stream_outbox_limit
+            )
+            try:
+                req = self._submit(
+                    prompt, max_new_tokens, eos_token=eos_token, sink=st
+                )
+            except BaseException:
+                # rejected submit (overload/capacity): reap the stream
+                # NOW — gc_finished only sweeps closed streams, and this
+                # one would otherwise sit open in the hub forever under
+                # exactly the sustained-overload condition
+                transport.hub().remove(st.sid)
+                raise
+            st.cancel_cb = lambda: self.engine.cancel(req)
+            return {
+                "sid": st.sid,
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", ""),
+            }
+
+        async def engine_stream_next(self, sid, max_frames=16, timeout=30.0):
+            """Pull-path fallback (no direct-call transport): drain the
+            stream's outbox through the normal actor-call path.  Runs the
+            blocking wait on an executor so concurrent requests keep
+            flowing through the replica's loop."""
+            import asyncio
+
+            from ray_tpu.serve.engine import transport
+
+            st = transport.hub().get(int(sid))
+            if st is None:
+                return [], True
+            frames, done = await asyncio.get_running_loop().run_in_executor(
+                None, st.pull, int(max_frames), float(timeout)
+            )
+            if done:
+                transport.hub().remove(int(sid))
+            return frames, done
+
+        def engine_stream_state(self, sid):
+            """Stream delivery introspection (ops/debug surface): outbox
+            depth, writer/ring state, wire cursor."""
+            from ray_tpu.serve.engine import transport
+
+            st = transport.hub().get(int(sid))
+            if st is None:
+                return {"gone": True}
+            out = {
+                "frames_queued": len(st._frames),
+                "attached": st._writer is not None,
+                "seq": st._seq,
+                "closed": st.closed,
+                "finished": st.finished,
+            }
+            w = st._writer
+            if w is not None:
+                out.update(
+                    {
+                        "ring": w._ring is not None,
+                        "ring_unusable": w._ring_unusable,
+                        "broken": w.broken,
+                        "co_located": w._co_located,
+                    }
+                )
+                if w._ring is not None:
+                    out["ring_seqs"] = w._ring._seqs()
+            return out
+
+        def engine_stream_cancel(self, sid):
+            from ray_tpu.serve.engine import transport
+
+            st = transport.hub().get(int(sid))
+            if st is not None and st.cancel_cb is not None:
+                st.cancel_cb()
+            transport.hub().remove(int(sid))
+            return True
+
+        # ---- observe / manage
+
+        def engine_stats(self):
+            return self.engine.stats()
+
+        def defrag(self):
+            return self.engine.defrag()
+
+        def reconfigure(self, user_config):
+            """Live knobs only (queue bound for load shedding); geometry
+            is baked into compiled programs."""
+            if user_config and "max_queue" in user_config:
+                self.engine.reconfigure(max_queue=int(user_config["max_queue"]))
+
+        def info(self):
+            return {
+                "platform": self.platform,
+                "params_b": round(self.llm.cfg.num_params() / 1e9, 2),
+                "tp": self.llm.tp,
+                "engine": self.engine.stats(),
+                "shards": self.llm.shard_stats(),
+            }
+
+    return LLMEngineDeployment

@@ -231,6 +231,31 @@ def _live_scrape() -> str:
                 with probe.phase("compute"):
                     time.sleep(0.001)
         probe.flush()
+        # continuous-batching engine plane: a few generations through a
+        # tiny engine deployment so the ray_tpu_serve_engine_* gauge
+        # families (slots, kv pages, queue depth, tokens) and the serve
+        # TTFT/TPOT histograms all exist in the scrape under validation
+        import jax.numpy as jnp
+
+        from ray_tpu import serve
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.serve.llm import engine_llm_deployment
+
+        cfg = LlamaConfig(
+            dim=32, n_layers=1, n_heads=2, n_kv_heads=2, hidden_dim=64,
+            vocab_size=128, compute_dtype=jnp.float32, max_seq_len=32,
+        )
+        dep = engine_llm_deployment(
+            cfg, new_tokens=4, num_slots=2, page_size=4, prefill_chunk=4,
+            num_tpus=0, tp=1, name="prom_llm",
+        )
+        handle = serve.run(dep.bind())
+        import ray_tpu as _rt
+
+        _rt.get(
+            [handle.remote({"prompt": [i + 1, i + 2]}) for i in range(3)],
+            timeout=600,
+        )
         # let the observer loop tick (memory + slo gauges land in kv)
         deadline = time.time() + 20
         addr = None
@@ -239,13 +264,23 @@ def _live_scrape() -> str:
             addr = nodes[0]["Labels"].get("metrics_addr")
             if addr:
                 text = _scrape(f"http://{addr}/metrics")
-                if "ray_tpu_slo_ok" in text and "ray_tpu_shm_used_bytes" in text:
+                if (
+                    "ray_tpu_slo_ok" in text
+                    and "ray_tpu_shm_used_bytes" in text
+                    and "ray_tpu_serve_engine_slots" in text
+                ):
                     return text
             time.sleep(1.0)
         if not addr:
             raise RuntimeError("head advertised no metrics_addr")
         return _scrape(f"http://{addr}/metrics")
     finally:
+        try:
+            from ray_tpu import serve
+
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 -- scrape already captured; teardown is best-effort
+            pass
         ray_tpu.shutdown()
 
 
