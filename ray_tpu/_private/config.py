@@ -57,6 +57,13 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "max_object_reconstructions": (int, 3, "re-executions allowed to recover a lost object"),
     "function_fetch_timeout_s": (float, 30.0, "max server-side wait for a function-table KV fetch (widen for chaos/slow CI)"),
     "object_pull_attempts": (int, 3, "backoff-disciplined attempts for a cross-node object pull before declaring it lost"),
+    # -- multi-tenant priorities / preemption (gcs/server.py) --
+    "task_preemption_budget": (int, 16, "default preemptions a normal task tolerates before its returns seal a typed PreemptedError (per-task override: max_preemptions)"),
+    "actor_preempt_save_deadline_s": (float, 5.0, "wall-clock budget for a preempted actor's __ray_save__; a missing/late reply escalates to SIGKILL with the restart budget charged"),
+    "priority_starvation_s": (float, 30.0, "queued longer than this boosts a task one band, so a starved low-band job still drains under sustained high-band load"),
+    "priority_fair_quantum_s": (float, 0.1, "deficit drained from a job's fair-share counter per dispatch (within-band weighted round-robin over queue-wait)"),
+    "slo_preempt_sustain_ticks": (int, 2, "consecutive breaching observer ticks before an SLO with preempt_below_band triggers a policy preemption"),
+    "slo_preempt_cooldown_s": (float, 5.0, "minimum spacing between SLO-policy preemptions"),
     # -- fault injection (deterministic chaos; see _private/CHAOS.md) --
     "chaos_enable": (bool, False, "make this process chaos-aware: subscribe to runtime arm/disarm pushes"),
     "chaos_seed": (int, 0, "deterministic fault-injection seed (same seed + plan => same per-stream fault sequence)"),

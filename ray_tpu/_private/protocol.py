@@ -145,6 +145,14 @@ class MsgType(enum.IntEnum):
     # doorbell-free carrier) — same transport contract as compiled DAGs
     ENGINE_STREAM = 102
 
+    # multi-tenant preemption (gcs/server.py victim selection): head →
+    # actor worker request to checkpoint (`__ray_save__` under a deadline,
+    # checkpoint lands in head KV `actor_ckpt:<id>`) and release; a
+    # missing/late/failed reply escalates to SIGKILL with the restart
+    # budget charged.  Respawn-with-restore rides the normal actor-restart
+    # FSM once capacity returns.
+    PREEMPT_ACTOR = 103
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

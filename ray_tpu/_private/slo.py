@@ -76,6 +76,21 @@ def parse_specs(blob) -> List[dict]:
                 raise ValueError(f"SLO {spec['name']!r}: gauge spec needs 'max'")
         if float(spec.get("window_s", 60.0)) <= 0:
             raise ValueError(f"SLO {spec['name']!r}: window_s must be > 0")
+        if "preempt_below_band" in spec:
+            # policy output: a sustained burn on this SLO preempts work
+            # whose priority band is strictly below this value, and holds
+            # re-admission of parked preempted actors until recovery
+            # (gcs/server.py _apply_slo_policy)
+            try:
+                band = int(spec["preempt_below_band"])
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"SLO {spec['name']!r}: preempt_below_band must be an int"
+                )
+            if band < 0:
+                raise ValueError(
+                    f"SLO {spec['name']!r}: preempt_below_band must be >= 0"
+                )
         out.append(spec)
     return out
 

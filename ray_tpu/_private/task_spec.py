@@ -51,6 +51,19 @@ class TaskSpec:
     node_affinity: Optional[bytes] = None  # node id, soft=false only
     seq_no: int = 0  # per-caller ordering for actor tasks
     caller_id: bytes = b""
+    # multi-tenant scheduling band: higher dispatches first; a band-N
+    # request that cannot place may preempt band-<N work (gcs/server.py
+    # victim selection).  0 = best-effort, 1 = normal (default), 2+ =
+    # latency-critical.  Defaults to the submitting driver's job-level
+    # priority (ray_tpu.init(priority=...)).
+    priority: int = 1
+    # actors only: opt in to checkpoint-respawn preemption — the scheduler
+    # may run `__ray_save__` (deadline-bounded), release this actor's
+    # resources, and respawn-with-`__ray_restore__` when capacity returns
+    preemptible: bool = False
+    # normal tasks: preemptions tolerated before the return objects seal a
+    # typed PreemptedError; -1 = RayConfig.task_preemption_budget
+    max_preemptions: int = -1
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     # set when the worker owning this actor should claim the real TPU chip
     claim_tpu: bool = False

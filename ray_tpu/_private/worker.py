@@ -59,6 +59,7 @@ def init(
     object_store_memory: Optional[int] = None,
     namespace: str = "",
     runtime_env: Optional[dict] = None,
+    priority: Optional[int] = None,
     _system_config: Optional[dict] = None,
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
@@ -67,6 +68,14 @@ def init(
     """Start (or connect to) a cluster and attach this process as driver.
 
     Reference semantics: python/ray/_private/worker.py:1031.
+
+    ``priority`` sets this job's scheduling band (0 = best-effort, 1 =
+    normal, 2+ = latency-critical): every task/actor this driver submits
+    defaults to it (per-call ``.options(priority=...)`` overrides), and a
+    higher-band request that cannot place may preempt lower-band work
+    (see STATUS.md "Multi-tenancy").  Defaults to ``RAY_TPU_JOB_PRIORITY``
+    from the environment (what ``JobSubmissionClient.submit_job(priority=
+    ...)`` sets for its entrypoint), else 1.
     """
     from ray_tpu.runtime_context import RuntimeContext
 
@@ -110,6 +119,9 @@ def init(
         dict.fromkeys(extra_paths + ([existing] if existing else []))
     )
     cw = CoreWorker(host, port, mode="driver", worker_env=worker_env)
+    if priority is None:
+        priority = int(os.environ.get("RAY_TPU_JOB_PRIORITY", "1") or 1)
+    cw.default_priority = int(priority)
     global_worker.core_worker = cw
     global_worker.mode = "driver"
     global_worker.address = f"{host}:{port}"

@@ -67,6 +67,12 @@ def zygote_main():
                 os.setsid()
             except OSError:
                 pass
+            # clear-and-set, not update-over-base: the request carries the
+            # COMPLETE intended env, and keys deliberately absent from a
+            # later spawn's dict (e.g. TPU-claim vars stripped for pool
+            # workers) must not be silently inherited from whatever env
+            # the zygote itself was started with
+            os.environ.clear()
             os.environ.update(req.get("env") or {})
             try:
                 log = req.get("log")

@@ -118,6 +118,32 @@ class ActorClass:
     def _remote(self, args, kwargs, opts) -> ActorHandle:
         from ray_tpu._private import worker as worker_mod
 
+        if opts.get("preemptible"):
+            # checkpoint-respawn preemption relies on the sequential
+            # actor's one-call-at-a-time lock to fence __ray_save__
+            # against in-flight calls; concurrent/async actors run
+            # methods outside that lock, so a snapshot could be taken
+            # mid-call and acknowledged results silently rolled back on
+            # restore — reject loudly instead
+            import inspect as _inspect
+
+            if opts.get("max_concurrency", 1) > 1:
+                raise ValueError(
+                    "preemptible=True requires a sequential actor "
+                    "(max_concurrency=1): the checkpoint fence cannot "
+                    "cover concurrent method execution"
+                )
+            if any(
+                _inspect.iscoroutinefunction(m)
+                for _, m in _inspect.getmembers(
+                    self._cls, predicate=_inspect.isfunction
+                )
+            ):
+                raise ValueError(
+                    "preemptible=True is not supported for async actors: "
+                    "methods run on the actor's event loop outside the "
+                    "checkpoint fence"
+                )
         cw = worker_mod._require_connected()
         if self._function_id is None or self._exported_by is not cw:
             self._function_id, _ = cw.export_function(self._cls)
@@ -169,5 +195,10 @@ class ActorClass:
             pg_bundle_index=bundle_index,
             runtime_env=opts.get("runtime_env"),
             node_affinity=node_affinity,
+            # multi-tenant band (None -> the driver's job-level priority);
+            # preemptible opts in to checkpoint-respawn eviction via the
+            # optional __ray_save__/__ray_restore__ hooks
+            priority=opts.get("priority"),
+            preemptible=bool(opts.get("preemptible", False)),
         )
         return ActorHandle(actor_id, self._cls.__name__, self._function_id, cw)

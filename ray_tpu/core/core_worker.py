@@ -45,6 +45,7 @@ from ray_tpu.exceptions import (
     GetTimeoutError,
     HeadUnreachableError,
     ObjectLostError,
+    PreemptedError,
     RayActorError,
     RaySystemError,
     RayTaskError,
@@ -61,6 +62,7 @@ _ERROR_CLASSES = {
     "WorkerCrashedError": WorkerCrashedError,
     "SchedulingError": RaySystemError,
     "ObjectLostError": ObjectLostError,
+    "PreemptedError": PreemptedError,
 }
 
 
@@ -87,6 +89,16 @@ def _error_from_string(msg: str) -> Exception:
         return cls(reason=rest.strip() or msg)
     if cls is TaskCancelledError:
         return TaskCancelledError()
+    if cls is PreemptedError:
+        # the head seals "... (attempt N/M)": recover the accounting so
+        # callers can read .attempt/.budget off the typed error
+        import re as _re
+
+        m = _re.search(r"attempt (\d+)/(\d+)", rest)
+        base = rest.rsplit(" (attempt ", 1)[0].strip() or "task preempted"
+        if m:
+            return PreemptedError(base, int(m.group(1)), int(m.group(2)))
+        return PreemptedError(base)
     if cls:
         try:
             return cls(rest.strip() or msg)
@@ -180,6 +192,13 @@ class CoreWorker:
         self._direct_probe_at: Dict[bytes, float] = {}
         self._actor_events_subscribed = False
         self._push_task_handler: Optional[Callable[[dict], None]] = None
+        # multi-tenant scheduling: the job-level band every spec this
+        # process submits defaults to (ray_tpu.init(priority=...) /
+        # RAY_TPU_JOB_PRIORITY); per-call .options(priority=) overrides
+        self.default_priority = 1
+        # head → actor-worker checkpoint request (PREEMPT_ACTOR); the
+        # worker runtime installs the handler that runs __ray_save__
+        self._preempt_handler: Optional[Callable[[dict], dict]] = None
         self._early_pushes: List[dict] = []  # frames that raced handler setup
         self._disconnect_cbs: List[Callable[[], None]] = []
         self._subscriptions: Dict[str, List[Callable[[dict], None]]] = {}
@@ -267,6 +286,10 @@ class CoreWorker:
                             logger.exception("pubsub subscriber callback raised")
                 elif msg_type == MsgType.CANCEL_TASK and self._push_task_handler:
                     self._push_task_handler({"cancel": payload.get("task_id")})
+                elif msg_type == MsgType.PREEMPT_ACTOR:
+                    # checkpoint request: __ray_save__ is user code — run
+                    # it on its own thread, never on this io loop
+                    self._on_preempt_request(rid, payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             self._conn_lost = True
             self.connected = False
@@ -953,6 +976,8 @@ class CoreWorker:
         pg_bundle_index: int,
         node_affinity: Optional[bytes] = None,
         runtime_env: Optional[dict] = None,
+        priority: Optional[int] = None,
+        max_preemptions: Optional[int] = None,
     ) -> List[ObjectRef]:
         if runtime_env:
             from ray_tpu._private.runtime_env import process_runtime_env
@@ -979,6 +1004,12 @@ class CoreWorker:
             trace_ctx=_new_span(),
             phases=_new_phases(),
             runtime_env=runtime_env or {},
+            priority=int(
+                priority if priority is not None else self.default_priority
+            ),
+            max_preemptions=(
+                int(max_preemptions) if max_preemptions is not None else -1
+            ),
         )
         # fire-and-forget on the ordered conn: queueing cannot fail in a
         # way the caller could act on (failures seal into the return
@@ -1005,6 +1036,8 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         implicit_cpu: bool = False,
         node_affinity: Optional[bytes] = None,
+        priority: Optional[int] = None,
+        preemptible: bool = False,
     ) -> ObjectRef:
         from ray_tpu._private.ids import ActorID
 
@@ -1039,6 +1072,10 @@ class CoreWorker:
             trace_ctx=_new_span(),
             phases=_new_phases(),
             runtime_env=runtime_env or {},
+            priority=int(
+                priority if priority is not None else self.default_priority
+            ),
+            preemptible=bool(preemptible),
         )
         self.request(MsgType.CREATE_ACTOR, {"spec": spec.to_wire()})
         return ObjectRef(spec.return_object_ids()[0], self)
@@ -1072,6 +1109,11 @@ class CoreWorker:
             caller_id=self.worker_id.binary(),
             trace_ctx=_new_span(),
             phases=_new_phases(),
+            # actor calls execute on the actor's own worker, but carrying
+            # the submitter's band lets the executing method's NESTED
+            # submissions inherit the job priority (worker_main seeds
+            # default_priority from the running spec)
+            priority=int(self.default_priority),
         )
         conn = self._direct_conn(actor_id)
         if conn is not None:
@@ -1483,6 +1525,38 @@ class CoreWorker:
             )
         )
         return True
+
+    def set_preempt_handler(self, handler: Callable[[dict], dict]):
+        """Install the actor runtime's checkpoint handler (worker_main
+        ``on_preempt``): payload → reply dict, run off the io loop."""
+        self._preempt_handler = handler
+
+    def _on_preempt_request(self, rid: int, payload: dict):
+        handler = self._preempt_handler
+
+        def _run():
+            try:
+                if handler is None:
+                    result = {"ok": False, "error": "no actor runtime"}
+                else:
+                    result = handler(payload)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "__ray_save__ checkpoint failed; the head will escalate "
+                    "to a budget-charged kill",
+                    exc_info=True,
+                )
+                result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                self.io.spawn(self.conn.reply(rid, result))
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "preempt reply could not be sent (head conn lost); the "
+                    "head's rpc timeout escalates on its own",
+                    exc_info=True,
+                )
+
+        threading.Thread(target=_run, name="preempt-save", daemon=True).start()
 
     def set_push_task_handler(self, handler: Callable[[dict], None]):
         self._push_task_handler = handler

@@ -50,6 +50,13 @@ class WorkerRuntime:
         # executor steps (ray_tpu/dag/): a sequential actor keeps its
         # one-call-at-a-time contract across both modes
         self.actor_lock = threading.Lock()
+        # calls between dequeue and their TASK_DONE flush: the actor_lock
+        # covers only user code, so the preemption fence must ALSO wait
+        # for this to reach zero — a call whose completion report is
+        # still in flight when the checkpoint ships would be requeued by
+        # the head and double-executed on the restored state
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         self._dag_runtime = None  # lazy: ray_tpu.dag.executor.DagWorkerRuntime
         # per-caller sequential ordering across the head→direct transition
         # (reference analog: sequential_actor_submit_queue.cc): seq we expect
@@ -143,6 +150,84 @@ class WorkerRuntime:
             return  # spawn directives are raylet business, not ours
         self.task_queue.put(payload)
 
+    def on_preempt(self, payload: dict) -> dict:
+        """Checkpoint request from the head's preemptive scheduler
+        (PREEMPT_ACTOR), run on a dedicated thread (core_worker spawns
+        it).  Contract: the actor's optional ``__ray_save__`` runs under
+        the actor lock (a sequential actor is never checkpointed
+        mid-call) within the head's deadline; the returned state is
+        serialized into head KV ``actor_ckpt:<actor_id>`` BEFORE we
+        reply ok, so the head can SIGKILL this process immediately after
+        — ``__ray_restore__`` receives it verbatim on respawn.  Any
+        failure (busy past the deadline, save raised, no instance)
+        replies not-ok and the head escalates to a budget-charged kill."""
+        import time as _time
+
+        inst = self.actor.instance
+        if inst is None:
+            return {"ok": False, "error": "no actor instance"}
+        actor_id = bytes(payload.get("actor_id") or b"")
+        deadline = float(payload.get("save_deadline_s") or 5.0)
+        save = getattr(inst, "__ray_save__", None)
+        start = _time.time()
+        if not self.actor_lock.acquire(timeout=deadline):
+            return {"ok": False, "error": "actor busy past the save deadline"}
+        fenced = False
+        try:
+            # the lock only fences NEW user code; a call whose method
+            # already returned may still be storing results / flushing
+            # TASK_DONE — wait it out, or the head would see the task in
+            # running_tasks at kill time, requeue it, and double-execute
+            # it against checkpointed state that already includes it
+            if not self._drain_inflight(start + deadline):
+                return {
+                    "ok": False,
+                    "error": "in-flight call still reporting past the "
+                    "save deadline",
+                }
+            if save is None:
+                # nothing to checkpoint: release is still graceful
+                # (respawn re-runs __init__ from the original creation
+                # args) — hold the fence so no call ACKs a mutation the
+                # fresh __init__ then silently discards
+                fenced = True
+                return {"ok": True, "saved": False}
+            state = save()
+            if _time.time() - start > deadline:
+                # the head's rpc timeout has already escalated (or is
+                # about to); don't ship a checkpoint the protocol
+                # considers dead
+                return {
+                    "ok": False,
+                    "error": "__ray_save__ exceeded its deadline",
+                }
+            blob = serialization.dumps(state)
+            self.cw.kv_put(f"actor_ckpt:{actor_id.hex()}", blob)
+            # fence: the lock stays HELD from here until the head's
+            # SIGKILL lands — a queued call running (and ACKing a result
+            # to its caller) after the snapshot would be silently rolled
+            # back by the restore.  Deliberately never released on the
+            # success path; this process is about to die.
+            fenced = True
+            return {"ok": True, "saved": True}
+        finally:
+            if not fenced:
+                self.actor_lock.release()
+
+    def _drain_inflight(self, deadline_ts: float) -> bool:
+        """Wait (bounded) until no call sits between dequeue and its
+        TASK_DONE flush.  Caller holds actor_lock, so no NEW call can
+        enter user code while we wait; only completion tails drain."""
+        import time as _time
+
+        with self._inflight_cv:
+            while self._inflight:
+                rem = deadline_ts - _time.time()
+                if rem <= 0:
+                    return False
+                self._inflight_cv.wait(rem)
+            return True
+
     def dag_runtime(self):
         """Lazy compiled-DAG runtime (ray_tpu/dag/executor.py) — created on
         the first DAG_SETUP so workers that never join a compiled graph
@@ -157,6 +242,16 @@ class WorkerRuntime:
     # ------------------------------------------------------------ execution
 
     def _execute_guarded(self, spec: TaskSpec, reply_to=None):
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            self._execute_guarded_inner(spec, reply_to)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _execute_guarded_inner(self, spec: TaskSpec, reply_to=None):
         import time as _time
 
         from ray_tpu._private.config import RayConfig
@@ -173,6 +268,10 @@ class WorkerRuntime:
         ph = spec.phases
         if ph is not None:
             ph["worker_dequeue"] = exec_start
+        # nested submissions made by this task inherit its band: without
+        # this, a best-effort job's fan-out would silently escalate to the
+        # pool worker's default (band 1) and could preempt other tenants
+        self.cw.default_priority = spec.priority
         try:
             if spec.task_id in self.cancelled:
                 raise RayTaskError(
@@ -337,6 +436,11 @@ class WorkerRuntime:
             if ph is not None:
                 ph["arg_fetch_end"] = ph["exec_start"] = _time.time()
             self.actor.instance = cls(*args, **kwargs)
+            if spec.preemptible:
+                # respawn-with-restore: a checkpoint saved by a prior
+                # incarnation's __ray_save__ hands the state back before
+                # any queued call runs (one KV get, preemptible-only cost)
+                self._maybe_restore(spec)
             self._start_direct_server(spec.actor_id)
             return None
         if spec.task_type == ACTOR_TASK:
@@ -364,11 +468,43 @@ class WorkerRuntime:
             if self._concurrency_sem is None:
                 # sequential actor: eager calls and resident compiled-DAG
                 # steps (dag/executor.py takes the same lock) stay mutually
-                # excluded, preserving the one-call-at-a-time contract
-                with self.actor_lock:
+                # excluded, preserving the one-call-at-a-time contract.
+                # Step OUT of the in-flight count while waiting for the
+                # lock: a preemption fence holding it needs to see
+                # quiescence, and a call that never entered user code is
+                # exactly what the head safely requeues after the kill —
+                # counting it would turn every racing benign call into a
+                # forced (budget-charged) preemption.
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
+                self.actor_lock.acquire()
+                try:
+                    with self._inflight_cv:
+                        self._inflight += 1
                     return method(*args, **kwargs)
+                finally:
+                    self.actor_lock.release()
             return method(*args, **kwargs)
         raise ValueError(f"unknown task type {spec.task_type}")
+
+    def _maybe_restore(self, spec: TaskSpec):
+        key = f"actor_ckpt:{bytes(spec.actor_id).hex()}"
+        blob = self.cw.kv_get(key)
+        if not blob:
+            return
+        restore = getattr(self.actor.instance, "__ray_restore__", None)
+        if restore is None:
+            return
+        # a raising restore fails the creation task, which destroys the
+        # actor with "creation failed: ..." — a corrupt checkpoint must be
+        # loud, not silently discarded
+        restore(serialization.loads(bytes(blob)))
+        # one-shot: a consumed checkpoint must not survive into a LATER
+        # genuine-fault restart, which promises a fresh __init__ — without
+        # this, a crash long after re-admission would silently roll the
+        # actor back to the stale preemption snapshot
+        self.cw.kv_del(key)
 
     def _normalize_returns(self, spec: TaskSpec, results: Any):
         oids = spec.return_object_ids()
@@ -500,6 +636,7 @@ def main():
     # handler must be live BEFORE registering: the head pushes the first task
     # the moment registration lands
     cw.set_push_task_handler(runtime.on_push)
+    cw.set_preempt_handler(runtime.on_preempt)
     cw.register_as_worker(
         node_id, os.getpid(), has_tpu=bool(os.environ.get("RAY_TPU_WORKER_TPU"))
     )

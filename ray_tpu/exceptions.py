@@ -118,6 +118,35 @@ class WorkerCrashedError(RayError):
     pass
 
 
+class PreemptedError(RayError):
+    """The task was killed by the priority-preemptive scheduler to make
+    room for higher-band work — a *policy* decision, not a fault.
+
+    Preempted tasks auto-requeue through the normal retry machinery with
+    their own preemption budget (``max_preemptions`` /
+    ``task_preemption_budget``); this error only reaches callers when
+    that budget is exhausted.  ``attempt``/``budget`` carry the
+    accounting so callers can distinguish "the cluster was busy with more
+    important work" from a crashing task."""
+
+    def __init__(
+        self,
+        message: str = "task preempted by higher-priority work",
+        attempt: int = 0,
+        budget: int = 0,
+    ):
+        self.attempt = int(attempt)
+        self.budget = int(budget)
+        super().__init__(f"{message} (attempt {self.attempt}/{self.budget})")
+
+    def __reduce__(self):
+        # keep attempt/budget across process boundaries (default reduce
+        # would replay __init__ with the formatted message only)
+        msg = self.args[0] if self.args else "task preempted"
+        base = msg.rsplit(" (attempt ", 1)[0]
+        return (PreemptedError, (base, self.attempt, self.budget))
+
+
 class NodeDiedError(RayError):
     pass
 

@@ -88,7 +88,8 @@ def summarize_workloads(what: str = "tasks", limit: int = 0) -> Dict:
     recorder), "serve" (per-deployment stage latencies + TTFT/TPOT),
     "train" (step breakdown + jitter/MFU), "memory" (per-node shm
     occupancy, object accounting, DAG ring occupancy), "slo" (the
-    watchdog's verdicts)."""
+    watchdog's verdicts), "preemptions" (the priority scheduler's
+    victim log + counters + parked actors)."""
     return _cw().request(MsgType.TASK_SUMMARY, {"what": what, "limit": limit})
 
 

@@ -62,12 +62,17 @@ def _percentiles(vals: List[float]) -> dict:
         "mean": sum(vals) / n,
     }
 
-# Actor FSM states (reference: gcs_actor_manager.cc state machine)
-ACTOR_PENDING, ACTOR_ALIVE, ACTOR_RESTARTING, ACTOR_DEAD = (
+# Actor FSM states (reference: gcs_actor_manager.cc state machine).
+# PREEMPTED is the one addition over the reference: the scheduler evicted
+# the actor by policy (checkpoint saved, resources released) and it parks
+# until capacity returns — distinct from RESTARTING so the worker-death
+# path knows not to charge the fault-restart budget.
+ACTOR_PENDING, ACTOR_ALIVE, ACTOR_RESTARTING, ACTOR_DEAD, ACTOR_PREEMPTED = (
     "PENDING_CREATION",
     "ALIVE",
     "RESTARTING",
     "DEAD",
+    "PREEMPTED",
 )
 
 
@@ -240,7 +245,8 @@ class TaskEntry:
 
     __slots__ = (
         "spec", "state", "worker_id", "node_id", "caller_conn_id", "blocked",
-        "wire", "res_shape",
+        "wire", "res_shape", "enqueued_at", "preempted", "preempt_count",
+        "preempt_requested_at",
     )
 
     def __init__(self, spec: TaskSpec, caller_conn_id: int, wire=None):
@@ -251,6 +257,17 @@ class TaskEntry:
         self.caller_conn_id = caller_conn_id
         self.blocked = False  # worker released cpu while waiting in get()
         self.res_shape = None  # cached sorted resource tuple (scheduler scan)
+        # queue-wait clock for fair-share deficits + starvation boosts;
+        # independent of the flight recorder so priorities work with
+        # RAY_TPU_TASK_EVENTS=0 (it measures the same head_enqueue→dispatch
+        # window the queue_wait phase records)
+        self.enqueued_at = time.time()
+        # preemption accounting: the scheduler killed this running task by
+        # policy (requeue, don't charge the fault-retry budget); the count
+        # seals a typed PreemptedError once the preemption budget is spent
+        self.preempted = False
+        self.preempt_count = 0
+        self.preempt_requested_at = 0.0  # rate-limits victim scans per entry
         # the submit frame's wire form, reused verbatim for the PUSH_TASK
         # dispatch — re-encoding the spec per hop was measurable on the
         # task hot path
@@ -353,6 +370,26 @@ class HeadServer:
         self._slo_specs: List[dict] = []
         self._slo_evals: Dict[str, object] = {}
         self._slo_state: Dict[str, dict] = {}
+        # multi-tenant preemption (ROADMAP item 5): within-band fair-share
+        # deficits keyed by (band, job), accumulated from queue-wait and
+        # drained per dispatch
+        self._job_deficit: Dict[Tuple[int, bytes], float] = {}
+        self._fair_tick_at = time.time()
+        # actors evicted by policy (checkpoint saved, resources released),
+        # parked until capacity returns: actor_id -> parked-since ts
+        self._preempted_parked: Dict[bytes, float] = {}
+        # actors with a PREEMPT_ACTOR rpc in flight (double-preempt guard)
+        self._preempting: Set[bytes] = set()
+        # rolling preemption log → `ray-tpu summary preemptions`
+        self._preempt_log: "deque" = deque(maxlen=512)
+        # head-owned ray_tpu_preemptions_total{band,kind} counter records
+        self._counter_cache: Dict[str, dict] = {}
+        # SLO policy: while a preempt_below_band SLO burns, new low-band
+        # re-admissions hold; recovery clears it and parked work returns
+        self._slo_preempt_hold = False
+        self._slo_breach_ticks: Dict[str, int] = {}
+        self._last_policy_preempt = 0.0
+        self._preempt_scans_left = 0  # per-tick victim-scan budget
 
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
@@ -985,10 +1022,56 @@ class HeadServer:
                 # submit-time pin here (the restart path re-pins)
                 self._unpin_args(entry.spec)
                 continue
+            if entry.spec.task_type == ACTOR_TASK:
+                actor = self.actors.get(entry.spec.actor_id)
+                if actor is not None and actor.state == ACTOR_PREEMPTED:
+                    # graceful preemption: the save fence held the actor
+                    # lock, so this pushed call never entered user code —
+                    # requeue it for the respawn exactly like a call that
+                    # arrives one RPC later, instead of surfacing a policy
+                    # eviction to the caller as a WorkerCrashedError
+                    actor.pending_calls.append(entry.spec)
+                    continue
+            if entry.preempted:
+                # policy kill, not a fault: requeue on the preemption
+                # budget, never the retry budget — and when THAT budget is
+                # spent, seal a typed PreemptedError so callers can tell
+                # "evicted for more important work" from a crash
+                entry.preempted = False
+                entry.preempt_count += 1
+                budget = (
+                    entry.spec.max_preemptions
+                    if entry.spec.max_preemptions >= 0
+                    else RayConfig.task_preemption_budget
+                )
+                if entry.preempt_count <= budget:
+                    entry.state = "QUEUED"
+                    entry.worker_id = None
+                    entry.node_id = None
+                    entry.enqueued_at = time.time()
+                    self.tasks[tid] = entry
+                    self.task_queue.append(entry)
+                    logger.info(
+                        "requeueing preempted task %s (preemption %d/%d)",
+                        entry.spec.function_name,
+                        entry.preempt_count,
+                        budget,
+                    )
+                else:
+                    self._unpin_args(entry.spec)
+                    await self._seal_error_objects(
+                        entry.spec,
+                        f"PreemptedError: preempted by higher-priority work "
+                        f"(attempt {entry.preempt_count}/{budget})",
+                    )
+                continue
             if entry.spec.retries_left > 0:
                 entry.spec.retries_left -= 1
                 entry.state = "QUEUED"
                 entry.worker_id = None
+                # fresh queue-wait clock: a long-RUNNING task's crash must
+                # not instantly qualify it for the starvation boost
+                entry.enqueued_at = time.time()
                 self.tasks[tid] = entry  # stays tracked across the retry
                 self.task_queue.append(entry)
                 logger.info("retrying task %s (%d retries left)", entry.spec.function_name, entry.spec.retries_left)
@@ -1051,19 +1134,34 @@ class HeadServer:
         actor.worker_id = None
         actor.node_id = None
         actor.direct_addr = ""
+        # the death event is where a preemption reservation ends: the
+        # forced-escalation path keeps the actor reserved in _preempting
+        # until here so a concurrent victim scan can't re-preempt the
+        # ALIVE-again actor and turn a budget-charged fault kill into an
+        # uncharged graceful park
+        self._preempting.discard(actor.actor_id)
+        if actor.state == ACTOR_PREEMPTED:
+            # policy eviction, checkpoint already saved: park until
+            # capacity returns (the scheduler loop re-admits) — the
+            # fault-restart budget is NOT charged; this death is the
+            # graceful release the preemption protocol asked for
+            actor.creation_cpu_released = False
+            self._preempted_parked.setdefault(actor.actor_id, time.time())
+            self._record_event(
+                "WARNING",
+                "preempt",
+                "actor preempted: checkpointed and released; parked for "
+                "re-admission",
+                actor_id=actor.actor_id.hex(),
+            )
+            await self._publish(
+                "actor", {"actor_id": actor.actor_id, "state": ACTOR_PREEMPTED}
+            )
+            self._kick_scheduler()
+            return
         if actor.restarts_used < actor.max_restarts or actor.max_restarts == -1:
             actor.restarts_used += 1
-            actor.state = ACTOR_RESTARTING
-            # new incarnation: the re-queued creation acquires CPU afresh
-            actor.creation_cpu_released = False
-            spec = actor.creation_spec
-            # re-pin exactly like a fresh submit: the restarted creation
-            # task's h_task_done will unpin again (without this, restart
-            # underflows the arg refcounts and deletes live objects)
-            self._pin_args(spec)
-            entry = TaskEntry(spec, -1)
-            self.tasks[spec.task_id] = entry
-            self.task_queue.append(entry)
+            self._requeue_actor_creation(actor)
             logger.info(
                 "restarting actor %s (%d/%s)",
                 actor.actor_id.hex()[:8],
@@ -1088,12 +1186,36 @@ class HeadServer:
             )
         self._kick_scheduler()
 
+    def _requeue_actor_creation(self, actor: ActorInfo):
+        """Queue a fresh creation incarnation through the restart FSM —
+        shared by fault restarts and preemption re-admission so the two
+        paths cannot drift.  The new incarnation acquires CPU afresh, and
+        args are re-pinned exactly like a fresh submit: the restarted
+        creation task's h_task_done will unpin again (without this,
+        restart underflows the arg refcounts and deletes live objects)."""
+        actor.state = ACTOR_RESTARTING
+        actor.creation_cpu_released = False
+        spec = actor.creation_spec
+        self._pin_args(spec)
+        entry = TaskEntry(spec, -1)
+        self.tasks[spec.task_id] = entry
+        self.task_queue.append(entry)
+
     async def _destroy_actor(self, actor: ActorInfo, reason: str):
         if actor.detached:
             self._wal("dactor", bytes(actor.actor_id), None)
             self._mark_tables_dirty()
         if actor.state == ACTOR_DEAD:
             return
+        # a destroy racing a preemption wins: drop the parking-lot entry
+        # (no respawn), the in-flight reservation, and the saved
+        # checkpoint (nobody will restore it)
+        self._preempted_parked.pop(actor.actor_id, None)
+        self._preempting.discard(actor.actor_id)
+        ckpt_key = f"actor_ckpt:{actor.actor_id.hex()}"
+        if ckpt_key in self.kv:
+            del self.kv[ckpt_key]
+            self._wal("kv", ckpt_key, None)
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
         logger.info("actor %s dead: %s", actor.actor_id.hex()[:8], reason)
@@ -1120,10 +1242,8 @@ class HeadServer:
             w = self.workers.get(actor.worker_id)
             if w is not None:
                 w.actor_id = None
-                try:
-                    os.kill(w.pid, 15)
-                except OSError:
-                    pass
+                # reaches remote hosts too (raylet kill_worker directive)
+                self._kill_worker_process(w, 15)
             node = self.nodes.get(actor.node_id) if actor.node_id else None
             if node:
                 self._release_creation_cpu(actor, node, actor.creation_spec)
@@ -1761,7 +1881,12 @@ class HeadServer:
             self._unpin_args(spec)
             await self._seal_error_objects(spec, f"RayActorError: {actor.death_cause or 'actor is dead'}")
             return {"ok": False}
-        if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING) or actor.worker_id is None:
+        if (
+            actor.state in (ACTOR_PENDING, ACTOR_RESTARTING, ACTOR_PREEMPTED)
+            or actor.worker_id is None
+        ):
+            # PREEMPTED queues too: a call racing the checkpoint/release
+            # window must wait for the respawn, not land on a dying worker
             actor.pending_calls.append(spec)
             return {"ok": True, "queued": True}
         await self._push_actor_task(actor, spec)
@@ -1965,10 +2090,7 @@ class HeadServer:
             if actor.worker_id:
                 w = self.workers.get(actor.worker_id)
                 if w:
-                    try:
-                        os.kill(w.pid, 9)
-                    except OSError:
-                        pass
+                    self._kill_worker_process(w, 9)
         return {"ok": True}
 
     async def h_actor_state(self, cid, conn, p):
@@ -2379,8 +2501,10 @@ class HeadServer:
         "serve" (per-deployment stage latencies + TTFT/TPOT), "train"
         (per-run step breakdown + jitter/MFU), "memory" (per-node store
         occupancy, object accounting, DAG ring occupancy, spill
-        counters), "slo" (the watchdog's verdicts).  Reference analog:
-        `ray summary tasks`, state/state_cli.py."""
+        counters), "slo" (the watchdog's verdicts), "preemptions" (the
+        priority scheduler's victim log, counters, parked actors and
+        SLO hold).  Reference analog: `ray summary tasks`,
+        state/state_cli.py."""
         what = str(p.get("what", "tasks"))
         limit = int(p.get("limit", 0))
         if what == "serve":
@@ -2391,6 +2515,8 @@ class HeadServer:
             return self._summary_memory()
         if what == "slo":
             return self._summary_slo()
+        if what == "preemptions":
+            return self._summary_preemptions(limit)
         if what != "tasks":
             raise ValueError(f"unknown summary kind {what!r}")
         records = list(self.task_records)
@@ -3052,8 +3178,15 @@ class HeadServer:
         for pg in self.pgs.values():
             if pg.state in ("PENDING", "RESCHEDULING"):
                 self._try_place_pg(pg)
+        # re-admit actors parked by preemption once capacity returns (and
+        # no SLO-policy hold / queued higher-band work would immediately
+        # re-evict them)
+        if self._preempted_parked and not self._slo_preempt_hold:
+            self._readmit_preempted()
         if not self.task_queue:
             return
+        self._preempt_scans_left = 4  # bound victim-scan work per tick
+        self._order_task_queue()
         remaining: List[TaskEntry] = []
         spawn_demand: Dict[bytes, int] = {}
         # dispatch-capacity snapshot, PER NODE: idle workers + spawnable
@@ -3122,6 +3255,10 @@ class HeadServer:
                 # infeasible tasks queued and warns; the autoscaler reacts).
                 if shape is not None:
                     failed_shapes.add(shape)
+                # a band-above-floor request that cannot place may evict
+                # lower-band work (victims die async; a later tick places us)
+                if spec.priority > 0 and self._preempt_scans_left > 0:
+                    self._maybe_preempt(entry)
                 remaining.append(entry)
                 continue
             if node_slots.get(node.node_id, 0) <= 0:
@@ -3129,6 +3266,12 @@ class HeadServer:
                 # other nodes may still have slots: release the reservation
                 # and keep scanning rather than burning the global budget
                 self._release_task_resources(node, spec)
+                # the release invalidates failed_shapes' only-consumed-
+                # within-a-tick premise: a shape that failed while this
+                # reservation was held may fit now — clear so it isn't
+                # skipped for the rest of the scan (cost bounded by
+                # exhausted_skips, which caps how often this branch runs)
+                failed_shapes.clear()
                 remaining.append(entry)
                 exhausted_skips -= 1
                 continue
@@ -3250,6 +3393,12 @@ class HeadServer:
 
     async def _dispatch(self, entry: TaskEntry, node: NodeInfo, worker: WorkerInfo):
         spec = entry.spec
+        # fair-share: a dispatch drains a quantum from the job's deficit so
+        # siblings in the same band take the next turns
+        k = (spec.priority, bytes(spec.job_id or b""))
+        d = self._job_deficit.get(k)
+        if d is not None:
+            self._job_deficit[k] = max(0.0, d - RayConfig.priority_fair_quantum_s)
         if spec.phases is not None:
             # shared with entry.wire (see h_submit_task), so the stamp
             # rides the cached PUSH_TASK frame to the worker
@@ -3282,6 +3431,551 @@ class HeadServer:
                 exc_info=True,
             )
             await self._on_worker_dead(worker.worker_id, "push failed")
+
+    # ----------------------------------- multi-tenant priorities / preemption
+
+    def _order_task_queue(self):
+        """Priority-aware dispatch order: higher bands first (with a
+        one-band starvation boost once a task queues past
+        ``priority_starvation_s``, so a starved low-band job still
+        drains), weighted deficit fair-share within a band — each (band,
+        job) accumulates queue-wait while it has work queued and a
+        dispatch drains a quantum (``_dispatch``), so jobs that have
+        waited longest take the next turns — and FIFO as the tiebreak.
+        The single-tenant case (one band, one job) skips the sort: the
+        queue stays the plain FIFO the drain-throughput work in
+        ``_schedule_once`` was measured against."""
+        q = self.task_queue
+        now = time.time()
+        dt = max(0.0, now - self._fair_tick_at)
+        self._fair_tick_at = now
+        keys = {(e.spec.priority, bytes(e.spec.job_id or b"")) for e in q}
+        if len(keys) <= 1:
+            if self._job_deficit:
+                self._job_deficit = {
+                    k: v for k, v in self._job_deficit.items() if k in keys
+                }
+            return
+        # accumulate queue-wait once per (band, job) with work queued;
+        # prune jobs whose queue drained (bounds the dict by live tenants)
+        deficits = {k: v for k, v in self._job_deficit.items() if k in keys}
+        for k in keys:
+            deficits[k] = deficits.get(k, 0.0) + dt
+        self._job_deficit = deficits
+        starve = RayConfig.priority_starvation_s
+        order = {id(e): i for i, e in enumerate(q)}
+
+        def sort_key(e):
+            band = e.spec.priority
+            if starve > 0 and now - e.enqueued_at > starve:
+                band += 1  # starvation boost: one band up, never unbounded
+            return (
+                -band,
+                -deficits.get((e.spec.priority, bytes(e.spec.job_id or b"")), 0.0),
+                order[id(e)],
+            )
+
+        q.sort(key=sort_key)
+
+    def _readmit_preempted(self):
+        """Respawn-with-restore: when a parked preempted actor's creation
+        demand fits again and no queued higher-band work would immediately
+        re-evict it, re-queue the creation task through the normal restart
+        FSM (the worker restores from the saved checkpoint at creation).
+        The fault-restart budget stays untouched — preemption is policy,
+        not a fault."""
+        # only FEASIBLE queued work counts against re-admission: a
+        # permanently-infeasible high-band task (kept queued by design,
+        # see _schedule_once) must not starve parked actors forever.
+        # Fit answers are memoized per resource shape, so a deep
+        # homogeneous backlog costs one total_fit plus a band-skip pass —
+        # not the O(queue × nodes) scan the dispatch loop was
+        # restructured to avoid.
+        max_queued_band = -1
+        shape_feasible: Dict[tuple, bool] = {}
+        for e in self.task_queue:
+            if e.spec.priority <= max_queued_band:
+                continue
+            shape = e.res_shape
+            if shape is None:
+                shape = tuple(sorted(self._task_resources(e.spec).items()))
+            feas = shape_feasible.get(shape)
+            if feas is None:
+                res = dict(shape)
+                feas = any(
+                    n.alive and n.total_fit(res) for n in self.nodes.values()
+                )
+                shape_feasible[shape] = feas
+            if feas:
+                max_queued_band = e.spec.priority
+        for aid in list(self._preempted_parked):
+            actor = self.actors.get(aid)
+            if actor is None or actor.state != ACTOR_PREEMPTED:
+                self._preempted_parked.pop(aid, None)
+                continue
+            spec = actor.creation_spec
+            if spec.priority < max_queued_band:
+                continue  # higher-band work is still waiting for capacity
+            res = self._task_resources(spec)
+            if not any(
+                n.alive and n.can_fit(res) for n in self.nodes.values()
+            ):
+                continue
+            self._preempted_parked.pop(aid, None)
+            self._requeue_actor_creation(actor)
+            logger.info("re-admitting preempted actor %s", aid.hex()[:8])
+            self._record_event(
+                "INFO",
+                "preempt",
+                "actor re-admitted after preemption",
+                actor_id=aid.hex(),
+            )
+
+    def _maybe_preempt(self, entry: TaskEntry) -> bool:
+        """Victim selection for a band-N request that cannot place: find
+        ONE node whose total capacity could hold the demand, walk its
+        lower-band work bottom-up — idle preemptible-actor leases first
+        (nothing in flight), then running best-effort tasks (kill +
+        requeue on the preemption budget), then busy preemptible actors
+        (checkpoint-respawn) — and evict the minimal prefix whose release
+        covers the deficit.  All-or-nothing per node: freeing less than
+        the demand would thrash lower bands without producing a
+        placement."""
+        now = time.time()
+        save_deadline = RayConfig.actor_preempt_save_deadline_s
+        if now - entry.preempt_requested_at < save_deadline + 2.0:
+            return False  # victims from the last request may still be dying
+        self._preempt_scans_left -= 1
+        spec = entry.spec
+        if spec.pg_id:
+            return False  # PG demand is bundle-reserved; out of scope
+        demand = self._task_resources(spec)
+        band = spec.priority
+        nodes = [n for n in self.nodes.values() if n.alive]
+        if spec.node_affinity:
+            nodes = [n for n in nodes if n.node_id == spec.node_affinity]
+        # enumerate eligible victims ONCE cluster-wide, then node-filter
+        # the (much smaller) candidate lists per node — not one full
+        # actors+tasks table walk per node
+        idle_a, running, busy_a = self._victim_candidates(band)
+        for node in nodes:
+            if not node.total_fit(demand):
+                continue
+            nid = node.node_id
+            cand = (
+                [x for x in idle_a if x[1].node_id == nid],
+                [x for x in running if x[1].node_id == nid],
+                [x for x in busy_a if x[1].node_id == nid],
+            )
+            victims = self._select_victims(node, band, demand, cand)
+            if victims is None:
+                continue
+            entry.preempt_requested_at = now
+            why = (
+                f"band {band} "
+                f"{spec.function_name or spec.method_name or 'task'} "
+                "cannot place"
+            )
+            for kind, victim in victims:
+                if kind == "task":
+                    self._preempt_task_victim(victim, band, reason=why)
+                else:
+                    self._spawn_actor_preempt(victim, band, reason=why)
+            return True
+        return False
+
+    def _spawn_actor_preempt(
+        self, actor: ActorInfo, band: int, reason: str = ""
+    ) -> bool:
+        """Reserve the victim SYNCHRONOUSLY (before the coroutine ever
+        runs) and launch the checkpoint-respawn protocol.  Without the
+        sync add, every victim scan in the same tick would re-count this
+        actor's not-yet-released resources and over-evict elsewhere."""
+        if actor.state != ACTOR_ALIVE or actor.actor_id in self._preempting:
+            return False
+        self._preempting.add(actor.actor_id)
+        asyncio.get_running_loop().create_task(
+            self._preempt_actor(actor, band, reason=reason)
+        )
+        return True
+
+    def _victim_candidates(
+        self, band: int, node_id: Optional[bytes] = None
+    ) -> Tuple[List, List, List]:
+        """Preemption-eligible work strictly below `band`, bucketed in
+        the bottom-up eviction order — (idle preemptible actors, running
+        best-effort tasks, busy preemptible actors) — each entry a
+        (victim_band, obj, releasable_resources) tuple, lowest band
+        first.  The ONE eligibility predicate shared by demand-driven
+        victim selection and the SLO policy."""
+        idle_actors: List[Tuple[int, object, Dict[str, float]]] = []
+        busy_actors: List[Tuple[int, object, Dict[str, float]]] = []
+        running: List[Tuple[int, object, Dict[str, float]]] = []
+        for actor in self.actors.values():
+            cspec = actor.creation_spec
+            if (
+                actor.state != ACTOR_ALIVE
+                or not cspec.preemptible
+                or cspec.priority >= band
+                or actor.actor_id in self._preempting
+            ):
+                continue
+            if node_id is not None and actor.node_id != node_id:
+                continue
+            w = self.workers.get(actor.worker_id)
+            if w is None:
+                continue  # no process to strike
+            release = self._actor_lifetime_resources(cspec)
+            bucket = busy_actors if w.running_tasks else idle_actors
+            bucket.append((cspec.priority, actor, release))
+        for t in self.tasks.values():
+            if (
+                t.state != "RUNNING"
+                or t.preempted
+                or t.blocked
+                or t.spec.task_type != NORMAL_TASK
+                or t.spec.priority >= band
+                or t.spec.pg_id
+                or t.worker_id not in self.workers
+            ):
+                continue
+            if node_id is not None and t.node_id != node_id:
+                continue
+            running.append((t.spec.priority, t, self._task_resources(t.spec)))
+        for bucket in (idle_actors, running, busy_actors):
+            bucket.sort(key=lambda x: x[0])  # lowest band evicted first
+        return idle_actors, running, busy_actors
+
+    def _select_victims(
+        self,
+        node: NodeInfo,
+        band: int,
+        demand: Dict[str, float],
+        candidates: Optional[Tuple[List, List, List]] = None,
+    ) -> Optional[List[Tuple[str, object]]]:
+        """Bottom-up victim set on one node covering `demand`'s deficit,
+        or None when even evicting everything eligible wouldn't fit it.
+        `candidates` is the node-filtered _victim_candidates triple when
+        the caller already enumerated cluster-wide."""
+        avail = node.resources_available
+        deficit = {
+            k: v - avail.get(k, 0.0)
+            for k, v in demand.items()
+            if v > avail.get(k, 0.0) + 1e-9
+        }
+        if not deficit:
+            return []  # already fits; nothing to evict
+        idle_actors, running, busy_actors = (
+            candidates
+            if candidates is not None
+            else self._victim_candidates(band, node.node_id)
+        )
+        chosen: List[Tuple[str, object]] = []
+
+        def take(cands, kind):
+            for _, victim, release in cands:
+                if not deficit:
+                    return
+                covers = False
+                for k in list(deficit):
+                    r = release.get(k, 0.0)
+                    if r > 0:
+                        covers = True
+                        deficit[k] -= r
+                        if deficit[k] <= 1e-9:
+                            del deficit[k]
+                if covers:
+                    chosen.append((kind, victim))
+
+        take(idle_actors, "actor")  # idle leases: nothing in flight
+        if deficit:
+            take(running, "task")  # kill + requeue
+        if deficit:
+            take(busy_actors, "actor")  # checkpoint-respawn mid-work
+        return None if deficit else chosen
+
+    def _kill_worker_process(self, w: WorkerInfo, sig: int = 9):
+        """Signal a worker process wherever it lives: os.kill reaches only
+        this host, remote victims get a raylet directive.  An
+        undeliverable directive (node gone, raylet conn dead) runs the
+        worker-death path directly — a victim already marked preempted /
+        PREEMPTED must not survive in name only, wedged out of both the
+        victim scan and re-admission."""
+        if w.node_id == self.head_node_id:
+            try:
+                os.kill(w.pid, sig)
+            except OSError:
+                pass
+            return
+        node = self.nodes.get(w.node_id)
+        if node is None or node.conn is None:
+            asyncio.get_running_loop().create_task(
+                self._on_worker_dead(
+                    w.worker_id, "kill directive undeliverable (node gone)"
+                )
+            )
+            return
+
+        async def _deliver():
+            try:
+                await node.conn.send(
+                    MsgType.PUSH_TASK,
+                    {"directive": "kill_worker", "pid": w.pid, "sig": sig},
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "kill_worker directive to node %s failed; declaring "
+                    "worker %s dead",
+                    w.node_id.hex()[:8],
+                    w.worker_id.hex()[:8],
+                    exc_info=True,
+                )
+                await self._on_worker_dead(
+                    w.worker_id, "kill directive failed (raylet conn)"
+                )
+
+        asyncio.get_running_loop().create_task(_deliver())
+
+    def _preempt_task_victim(
+        self, entry: TaskEntry, band: int, reason: str = ""
+    ):
+        w = self.workers.get(entry.worker_id)
+        if w is None or entry.preempted:
+            return
+        entry.preempted = True
+        self._record_preemption(
+            "task",
+            victim_band=entry.spec.priority,
+            requester_band=band,
+            name=entry.spec.function_name,
+            victim=bytes(entry.spec.task_id).hex()[:16],
+            reason=reason,
+        )
+        # SIGKILL the worker; _on_worker_dead sees entry.preempted and
+        # requeues on the preemption budget (never the fault-retry budget)
+        self._kill_worker_process(w, 9)
+
+    async def _preempt_actor(
+        self, actor: ActorInfo, band: int, reason: str = ""
+    ):
+        """The checkpoint-respawn protocol: PREEMPT_ACTOR → the actor's
+        optional ``__ray_save__`` runs under
+        ``actor_preempt_save_deadline_s`` (the checkpoint lands in head
+        KV before the worker replies) → graceful release with NO
+        restart-budget charge, parked for re-admission.  A failed, late,
+        or missing reply escalates to SIGKILL through the normal fault
+        path — restart budget charged, immediate requeue.
+
+        Only entered via _spawn_actor_preempt, which already reserved
+        this actor in _preempting (synchronously, so same-tick victim
+        scans can't double-count its release); the reservation is
+        released in the finally below — EXCEPT on the forced path, where
+        it is held until the SIGKILL's death event lands
+        (_on_actor_worker_dead discards), so the window between
+        state=ALIVE and the worker actually dying can't be re-preempted
+        into an uncharged graceful park."""
+        keep_reserved = False
+        try:
+            if actor.state != ACTOR_ALIVE:
+                return
+            w = self.workers.get(actor.worker_id)
+            if w is None:
+                return
+            deadline = RayConfig.actor_preempt_save_deadline_s
+            # mark first: new calls queue in pending_calls instead of
+            # racing onto a worker that is about to release
+            actor.state = ACTOR_PREEMPTED
+            try:
+                reply = await w.conn.request(
+                    MsgType.PREEMPT_ACTOR,
+                    {"actor_id": actor.actor_id, "save_deadline_s": deadline},
+                    timeout=deadline + 3.0,
+                )
+                ok = bool(reply.get("ok"))
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "PREEMPT_ACTOR save rpc to %s failed/timed out; "
+                    "escalating to a budget-charged kill",
+                    actor.actor_id.hex()[:8],
+                    exc_info=True,
+                )
+                ok = False
+            if actor.state != ACTOR_PREEMPTED:
+                # destroyed or died while saving (preempt racing a
+                # voluntary exit / ray.kill): the other transition owns
+                # cleanup; do not park, do not kill twice
+                return
+            if ok:
+                self._record_preemption(
+                    "actor",
+                    victim_band=actor.creation_spec.priority,
+                    requester_band=band,
+                    name=actor.creation_spec.function_name,
+                    victim=actor.actor_id.hex()[:16],
+                    reason=reason,
+                )
+            else:
+                if actor.worker_id is None:
+                    # the worker died on its own while we were saving and
+                    # _on_actor_worker_dead already parked this PREEMPTED
+                    # actor — leave that transition in charge (flipping to
+                    # ALIVE here would strand a parked entry whose
+                    # re-admission check silently drops it: a permanent
+                    # ALIVE-with-no-worker wedge)
+                    return
+                # escalate: back to ALIVE so the death path charges the
+                # restart budget and requeues immediately (fault FSM);
+                # the _preempting reservation rides until that death event
+                actor.state = ACTOR_ALIVE
+                keep_reserved = True
+                self._record_preemption(
+                    "actor_forced",
+                    victim_band=actor.creation_spec.priority,
+                    requester_band=band,
+                    name=actor.creation_spec.function_name,
+                    victim=actor.actor_id.hex()[:16],
+                    reason=(reason + "; __ray_save__ missed its deadline")
+                    .strip("; "),
+                )
+            w2 = self.workers.get(actor.worker_id or b"")
+            if w2 is not None:
+                # checkpoint (if any) is already durable in head KV — the
+                # worker's kv_put completed before its reply — so SIGKILL
+                # is safe on both paths
+                self._kill_worker_process(w2, 9)
+        finally:
+            if not keep_reserved:
+                self._preempting.discard(actor.actor_id)
+
+    def _record_preemption(
+        self,
+        kind: str,
+        victim_band: int,
+        requester_band: int,
+        name: str = "",
+        victim: str = "",
+        reason: str = "",
+    ):
+        self._preempt_log.append(
+            {
+                "ts": time.time(),
+                "kind": kind,
+                "band": victim_band,
+                "requester_band": requester_band,
+                "name": name,
+                "victim": victim,
+                "reason": reason,
+            }
+        )
+        self._record_event(
+            "WARNING",
+            "preempt",
+            f"preempted {kind} {name or victim} "
+            f"(band {victim_band} -> requester band {requester_band})"
+            + (f": {reason}" if reason else ""),
+            kind=kind,
+            victim=victim,
+        )
+        self._inc_counter(
+            "ray_tpu_preemptions_total",
+            "Work evicted by the priority-preemptive scheduler, by victim "
+            "band and kind (task / actor / actor_forced)",
+            {"band": str(victim_band), "kind": kind},
+        )
+
+    def _inc_counter(self, metric, help_text, tags, inc: float = 1.0):
+        """Head-owned counter series, same kv write-through as
+        _set_gauge (deliberately not WAL-persisted)."""
+        import json as _json
+
+        from ray_tpu.util import metrics as metrics_mod
+
+        key = f"metrics:{metric}:{metrics_mod.tag_string(tags)}:head"
+        rec = self._counter_cache.get(key)
+        if rec is None:
+            rec = {
+                "kind": "counter",
+                "value": 0.0,
+                "description": help_text,
+                "tags": tags,
+            }
+            self._counter_cache[key] = rec
+        rec["value"] += inc
+        rec["ts"] = time.time()
+        self.kv[key] = _json.dumps(rec).encode()
+
+    def _summary_preemptions(self, limit: int = 0) -> dict:
+        """Backend of `ray-tpu summary preemptions`: the rolling victim
+        log, the counter families, parked actors, and the SLO hold."""
+        counts: Dict[str, float] = {}
+        prefix = "metrics:ray_tpu_preemptions_total:"
+        for key, rec in self._counter_cache.items():
+            if not key.startswith(prefix):
+                continue
+            tags = rec.get("tags") or {}
+            counts[
+                f"band={tags.get('band', '?')},kind={tags.get('kind', '?')}"
+            ] = rec.get("value", 0.0)
+        recs = list(self._preempt_log)
+        return {
+            "preemptions": recs[-limit:] if limit > 0 else recs,
+            "counts": counts,
+            "parked": [a.hex() for a in self._preempted_parked],
+            "slo_hold": self._slo_preempt_hold,
+            "total": len(recs),
+        }
+
+    def _apply_slo_policy(self, spec: dict, verdict: dict, now: float):
+        """SLO → policy: a sustained burn on a spec carrying
+        ``preempt_below_band`` evicts the lowest-band victim instead of
+        merely emitting a breach marker, and holds re-admission of parked
+        preempted work; recovery lifts the hold so it returns."""
+        band = spec.get("preempt_below_band")
+        if band is None:
+            return
+        name = spec["name"]
+        if verdict["ok"]:
+            if self._slo_breach_ticks.pop(name, None) is not None:
+                if not self._slo_breach_ticks and self._slo_preempt_hold:
+                    self._slo_preempt_hold = False
+                    self._record_event(
+                        "INFO",
+                        "preempt",
+                        f"slo {name} recovered: re-admitting preempted work",
+                        slo=name,
+                    )
+            return
+        ticks = self._slo_breach_ticks.get(name, 0) + 1
+        self._slo_breach_ticks[name] = ticks
+        if ticks < RayConfig.slo_preempt_sustain_ticks:
+            return
+        self._slo_preempt_hold = True
+        if now - self._last_policy_preempt < RayConfig.slo_preempt_cooldown_s:
+            return
+        if self._policy_preempt(
+            int(band), reason=f"slo {name} sustained burn"
+        ):
+            self._last_policy_preempt = now
+
+    def _policy_preempt(self, band_below: int, reason: str) -> bool:
+        """Evict ONE victim below `band_below`, lowest band first,
+        bottom-up across the cluster (idle preemptible actors, running
+        tasks, busy preemptible actors)."""
+        idle_actors, running, busy_actors = self._victim_candidates(band_below)
+        for cands, kind in (
+            (idle_actors, "actor"),
+            (running, "task"),
+            (busy_actors, "actor"),
+        ):
+            if not cands:
+                continue
+            victim = cands[0][1]
+            if kind == "task":
+                self._preempt_task_victim(victim, band_below, reason=reason)
+            else:
+                self._spawn_actor_preempt(victim, band_below, reason=reason)
+            return True
+        return False
 
     # ---------------------------------------------------------- maintenance
 
@@ -3478,6 +4172,12 @@ class HeadServer:
             self._slo_state = {
                 name: st for name, st in self._slo_state.items() if name in live
             }
+            # a removed policy SLO must not pin the re-admission hold
+            self._slo_breach_ticks = {
+                n: t for n, t in self._slo_breach_ticks.items() if n in live
+            }
+            if not self._slo_breach_ticks:
+                self._slo_preempt_hold = False
         if not self._slo_specs:
             return
         merged = self._slo_metrics_view()
@@ -3525,6 +4225,9 @@ class HeadServer:
                     slo=name,
                     value=verdict.get("value"),
                 )
+            # policy output: sustained burn → preempt the lowest band;
+            # recovery → lift the re-admission hold
+            self._apply_slo_policy(spec, verdict, now)
 
     async def _idle_reaper_loop(self):
         while not self._shutdown:

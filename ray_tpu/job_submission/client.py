@@ -83,11 +83,17 @@ class JobSubmissionClient:
         entrypoint: str,
         runtime_env: Optional[dict] = None,
         job_id: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> str:
         import ray_tpu
 
         job_id = job_id or f"raytpu_job_{uuid.uuid4().hex[:8]}"
         env = (runtime_env or {}).get("env_vars")
+        if priority is not None:
+            # job-level scheduling band: the entrypoint's ray_tpu.init()
+            # picks it up as its default priority (see _private/worker.py)
+            env = dict(env or {})
+            env["RAY_TPU_JOB_PRIORITY"] = str(int(priority))
         cls = ray_tpu.remote(_JobSupervisor)
         cls.options(name=f"_job_{job_id}", lifetime="detached", num_cpus=0).remote(
             job_id, entrypoint, env, self._address

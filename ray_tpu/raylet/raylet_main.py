@@ -256,6 +256,17 @@ class Raylet:
                     asyncio.get_running_loop().run_in_executor(
                         None, self._spawn_worker, bool(payload.get("tpu"))
                     )
+                elif (
+                    msg_type == MsgType.PUSH_TASK
+                    and payload.get("directive") == "kill_worker"
+                ):
+                    # preemption victim on this node: the head's os.kill
+                    # only reaches its own host, so the strike is delegated
+                    # here (worker death then flows back over the conn loss)
+                    try:
+                        os.kill(int(payload["pid"]), int(payload.get("sig", 9)))
+                    except (OSError, ValueError, KeyError):
+                        pass  # already gone / malformed: the head's failure detector owns the truth
                 elif msg_type == MsgType.OBJECT_PULL:
                     asyncio.get_running_loop().create_task(
                         self._handle_pull(conn, rid, payload)

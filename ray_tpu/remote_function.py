@@ -104,6 +104,10 @@ class RemoteFunction:
             pg_bundle_index=bundle_index,
             node_affinity=node_affinity,
             runtime_env=opts.get("runtime_env"),
+            # multi-tenant band (None -> the driver's job-level priority)
+            # and per-task preemption budget (None -> config default)
+            priority=opts.get("priority"),
+            max_preemptions=opts.get("max_preemptions"),
         )
         return refs[0] if num_returns == 1 else refs
 

@@ -230,6 +230,24 @@ def cmd_summary(args):
                 )
         _print_engine_gauges(reply.get("serve_engine", {}))
         return 0
+    if args.what == "preemptions":
+        counts = reply.get("counts", {})
+        print(
+            f"== preemptions == total={reply.get('total', 0)} "
+            f"parked_actors={len(reply.get('parked', []))} "
+            f"slo_hold={reply.get('slo_hold')}"
+        )
+        for key, n in sorted(counts.items()):
+            print(f"  {key}: {n:.0f}")
+        for rec in reply.get("preemptions", [])[-50:]:
+            print(
+                f"  {time.strftime('%H:%M:%S', time.localtime(rec['ts']))} "
+                f"{rec['kind']:12s} band={rec['band']} -> "
+                f"req_band={rec['requester_band']} "
+                f"{rec.get('name') or rec.get('victim', '')} "
+                f"{rec.get('reason', '')}"
+            )
+        return 0
     rows = reply.get("summary", [])
     if not rows:
         print(
@@ -329,7 +347,9 @@ def main():
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("summary", help="workload summaries from the flight recorder")
-    p.add_argument("what", choices=["tasks", "serve", "train", "memory"])
+    p.add_argument(
+        "what", choices=["tasks", "serve", "train", "memory", "preemptions"]
+    )
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
 

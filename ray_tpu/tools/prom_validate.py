@@ -256,6 +256,41 @@ def _live_scrape() -> str:
             [handle.remote({"prompt": [i + 1, i + 2]}) for i in range(3)],
             timeout=600,
         )
+        # multi-tenant plane: provoke one preemption so the
+        # ray_tpu_preemptions_total counter family (and the preempted
+        # task's typed PreemptedError path) is live in the scrape under
+        # validation.  A best-effort hog takes both CPUs with a zero
+        # preemption budget; a band-2 task that cannot place evicts it.
+        from ray_tpu.exceptions import PreemptedError
+
+        @ray_tpu.remote
+        def hog():
+            time.sleep(120)
+
+        @ray_tpu.remote
+        def urgent(x):
+            return x
+
+        hog_ref = hog.options(
+            priority=0, num_cpus=2, max_preemptions=0, max_retries=0
+        ).remote()
+        spin_deadline = time.time() + 60
+        # wait until the hog actually holds the CPUs
+        while ray_tpu.available_resources().get("CPU", 0.0) >= 0.5:
+            if time.time() > spin_deadline:
+                raise RuntimeError("hog task never started")
+            time.sleep(0.2)
+        assert (
+            ray_tpu.get(
+                urgent.options(priority=2, num_cpus=2).remote(7), timeout=120
+            )
+            == 7
+        )
+        try:
+            ray_tpu.get(hog_ref, timeout=60)
+            raise RuntimeError("hog survived preemption with a zero budget")
+        except PreemptedError:
+            pass
         # let the observer loop tick (memory + slo gauges land in kv)
         deadline = time.time() + 20
         addr = None
@@ -268,6 +303,7 @@ def _live_scrape() -> str:
                     "ray_tpu_slo_ok" in text
                     and "ray_tpu_shm_used_bytes" in text
                     and "ray_tpu_serve_engine_slots" in text
+                    and "ray_tpu_preemptions_total" in text
                 ):
                     return text
             time.sleep(1.0)

@@ -247,6 +247,10 @@ class DcnGroup:
         self._p2p_out: Dict[int, socket.socket] = {}
         self._p2p_in: Dict[int, socket.socket] = {}
         self._p2p_cv = threading.Condition()
+        # per-source recv serialization (mirrors the ring path's
+        # self._lock): two threads recv()ing from one src must not
+        # interleave frame reads on the same socket
+        self._p2p_recv_locks: Dict[int, threading.Lock] = {}
         self._p2p_token: Optional[str] = None
         self._closed = False
         if world_size > 1:
@@ -522,7 +526,13 @@ class DcnGroup:
 
     def recv(self, src_rank: int) -> np.ndarray:
         """Point-to-point receive from ANY rank (reference analog:
-        util/collective/collective.py:594 recv)."""
+        util/collective/collective.py:594 recv).
+
+        The read itself holds a per-source lock — concurrent recv() from
+        one src must not interleave frames on the shared socket — and
+        retries once when the socket failed because the accept loop
+        replaced it mid-read (peer redial closes the old socket under
+        us; the replacement carries the fresh stream)."""
         if src_rank == self.rank:
             raise ValueError("p2p recv from self")
         if src_rank == (self.rank - 1) % self.world_size:
@@ -530,13 +540,28 @@ class DcnGroup:
                 return self.recv_prev()
         deadline = time.time() + 120
         with self._p2p_cv:
+            lock = self._p2p_recv_locks.setdefault(src_rank, threading.Lock())
+        with lock:
+            sock = self._wait_p2p_sock(src_rank, deadline)
+            try:
+                return _recv_array(sock)
+            except OSError:
+                with self._p2p_cv:
+                    cur = self._p2p_in.get(src_rank)
+                if cur is None or cur is sock or self._closed:
+                    raise  # genuine transport failure, no replacement
+                return _recv_array(cur)
+
+    def _wait_p2p_sock(self, src_rank: int, deadline: float) -> socket.socket:
+        with self._p2p_cv:
             while src_rank not in self._p2p_in:
                 remaining = deadline - time.time()
                 if remaining <= 0 or not self._p2p_cv.wait(min(remaining, 5.0)):
                     if time.time() > deadline:
-                        raise TimeoutError(f"p2p recv: rank {src_rank} never connected")
-            sock = self._p2p_in[src_rank]
-        return _recv_array(sock)
+                        raise TimeoutError(
+                            f"p2p recv: rank {src_rank} never connected"
+                        )
+            return self._p2p_in[src_rank]
 
     def destroy(self):
         self._closed = True
