@@ -177,14 +177,37 @@ def _try_steps(cfg):
         return {"error": str(e)[:200]}
 
 
+_BACKEND_ERR_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "TPU backend setup",
+)
+
+
+def _is_backend_error(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}"
+    return any(m in msg for m in _BACKEND_ERR_MARKERS)
+
+
 def _train_loop(config):
     """Runs on the TPU worker actor via JaxTrainer.  config carries the
     primary model config and optionally a "secondary" config benched in
-    the same worker process (the chip has one claimant per session)."""
+    the same worker process (the chip has one claimant per session).
+
+    The driver's TPU probe can pass while the WORKER's backend init still
+    fails (flaky tunnel — BENCH_r05 died rc=1 exactly here): report the
+    failure as data instead of raising, so the driver can fall back to
+    CPU and say so in the JSON."""
     from ray_tpu.air import session
 
     secondary = config.pop("secondary", None)
-    out = _run_steps(config)
+    try:
+        out = _run_steps(config)
+    except Exception as e:  # noqa: BLE001
+        if _is_backend_error(e):
+            session.report({"backend_error": f"{type(e).__name__}: {e}"[:500]})
+            return
+        raise
     if secondary is not None and out["platform"] not in ("cpu",):
         out["secondary"] = _try_steps(secondary)
     session.report(out)
@@ -216,6 +239,7 @@ def main():
         cfg2["steps"] = 10
 
     m2 = None
+    backend_note = ""
     if raw:
         m = _run_steps(cfg_d)
         if cfg2 is not None and m["platform"] not in ("cpu",):
@@ -229,15 +253,41 @@ def main():
         import ray_tpu
         from ray_tpu.train import JaxTrainer, ScalingConfig
 
-        ray_tpu.init(num_cpus=4, num_tpus=1)
-        trainer = JaxTrainer(
-            _train_loop,
-            train_loop_config={**cfg_d, "secondary": cfg2},
-            scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
-        )
-        m = trainer.fit().metrics
+        def _fit(use_tpu: bool):
+            # use_tpu=False runs the same train path on a pool worker
+            # (spawned with JAX_PLATFORMS=cpu — it can never touch the
+            # claim env), which is what the CPU fallback needs
+            ray_tpu.init(num_cpus=4, num_tpus=1 if use_tpu else 0)
+            try:
+                trainer = JaxTrainer(
+                    _train_loop,
+                    train_loop_config={**cfg_d, "secondary": cfg2},
+                    scaling_config=ScalingConfig(num_workers=1, use_tpu=use_tpu),
+                )
+                return trainer.fit().metrics
+            finally:
+                ray_tpu.shutdown()
+
+        m = None
+        if not cpu_fallback:
+            try:
+                m = _fit(use_tpu=True)
+            except Exception as e:  # noqa: BLE001
+                # a sideways TPU backend can also KILL the worker outright
+                # (libtpu init abort) instead of raising in user code —
+                # same fallback, the crash is the evidence
+                backend_note = f"{type(e).__name__}: {e}"[:500]
+        if m is None or m.get("backend_error"):
+            # the probe said TPU but the spawned train worker's backend
+            # failed anyway (BENCH_r05 died rc=1 exactly here): fall back
+            # to CPU THROUGH the same train path and carry the evidence
+            # in the JSON instead of dying
+            if m is not None:
+                backend_note = m["backend_error"]
+            cpu_fallback = True
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            m = _fit(use_tpu=False)
         m2 = m.pop("secondary", None)
-        ray_tpu.shutdown()
 
     on_tpu = m["platform"] not in ("cpu",)
     mfu = m["tokens_per_sec"] * m["flops_per_token"] / peak
@@ -249,6 +299,7 @@ def main():
         "mfu": round(mfu, 4),
         "platform": m["platform"],
         "backend": "cpu_fallback" if cpu_fallback else m["platform"],
+        **({"backend_note": backend_note} if backend_note else {}),
         "tpu_gen": gen if on_tpu else "cpu-fallback",
         "path": "raw" if raw else "train",
         "batch": cfg_d["batch"],

@@ -57,6 +57,16 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "max_object_reconstructions": (int, 3, "re-executions allowed to recover a lost object"),
     "function_fetch_timeout_s": (float, 30.0, "max server-side wait for a function-table KV fetch (widen for chaos/slow CI)"),
     "object_pull_attempts": (int, 3, "backoff-disciplined attempts for a cross-node object pull before declaring it lost"),
+    # -- control-plane fast path: worker-lease caching / raylet dispatch /
+    #    sharded GCS (gcs/server.py, raylet/lease_agent.py, gcs/shards.py) --
+    "lease_cache_enabled": (bool, True, "drivers/workers cache worker leases per resource shape and push S-shaped task queues straight to the leased worker (head round-trip amortized to ~0 per task)"),
+    "lease_idle_timeout_s": (float, 2.0, "a cached lease with nothing in flight is returned to the head after this long idle"),
+    "lease_max_per_shape": (int, 8, "max concurrent leases a client holds per resource shape"),
+    "lease_queue_latency_budget_s": (float, 0.2, "max expected queue-wait a client may build on one lease (queue depth = budget / observed mean task duration): tiny tasks pipeline deep, long tasks spread breadth-first across leases or fall back to the head"),
+    "lease_revoke_deadline_s": (float, 2.0, "grace between LEASE_REVOKE and the head SIGKILLing the leased worker; a holder that drains + returns within it keeps every pushed task's single execution"),
+    "lease_request_retry_s": (float, 0.25, "client-side negative cache after a denied lease request (denials trigger a head-side worker spawn, so a retry shortly after usually grants)"),
+    "raylet_local_dispatch": (bool, True, "raylets grant leases for node-affine work from their local worker pool, band-ordered, reporting grants to the head asynchronously"),
+    "gcs_kv_shards": (int, 2, "shard event-loop threads serving the KV / object-locate / actor-directory read planes on their own listeners; 0 = everything on the head loop"),
     # -- multi-tenant priorities / preemption (gcs/server.py) --
     "task_preemption_budget": (int, 16, "default preemptions a normal task tolerates before its returns seal a typed PreemptedError (per-task override: max_preemptions)"),
     "actor_preempt_save_deadline_s": (float, 5.0, "wall-clock budget for a preempted actor's __ray_save__; a missing/late reply escalates to SIGKILL with the restart budget charged"),

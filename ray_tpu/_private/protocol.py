@@ -153,6 +153,23 @@ class MsgType(enum.IntEnum):
     # FSM once capacity returns.
     PREEMPT_ACTOR = 103
 
+    # control-plane fast path (worker-lease caching + raylet-local
+    # dispatch; gcs/server.py lease service, raylet/lease_agent.py,
+    # core_worker.py _LeaseCache).  A driver holding a lease for resource
+    # shape S pushes its whole queue of S-shaped tasks straight to the
+    # leased worker, amortizing the head round-trip to ~0 per task
+    # (reference analog: worker lease reuse in the raylet,
+    # node_manager.cc RequestWorkerLease + direct task submission).
+    LEASE_REQUEST = 104  # client → head/raylet-agent: grant a worker lease
+    LEASE_RETURN = 105  # client → grantor: release the lease (idle/revoked)
+    LEASE_REVOKE = 106  # grantor → client push: give it back (preemption)
+    LEASE_PUSH = 107  # client → leased worker: batched task specs (no rid)
+    LEASE_DONE = 108  # leased worker → client: batched task completions
+    TASK_STATS = 109  # worker → head: batched flight records for tasks
+    # that never transit the head (lease / raylet dispatch), so the
+    # queue-wait histograms split by granted_by stay complete
+    LEASE_NOTIFY = 110  # raylet → head: async accounting of local grants
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

@@ -9,6 +9,7 @@ release, release/release_tests.yaml:3411).  Run:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -118,6 +119,66 @@ def main():
     results["dag compiled vs eager speedup"] = compiled_rate / eager_rate
     print(f"dag compiled vs eager speedup: {compiled_rate / eager_rate:.1f}x")
     compiled.teardown()
+
+    # -- control-plane rows (worker-lease fast path, gcs/SCHEDULING.md):
+    # the same 10k queued-drain shape through the eager head path vs the
+    # cached-lease path, plus actor-fleet creation — the tracked numbers
+    # for ROADMAP item 1, not a one-off.
+    from ray_tpu._private.config import RayConfig
+
+    @ray_tpu.remote
+    def idx(i):
+        return i
+
+    def queued_drain(n):
+        t0 = time.perf_counter()
+        out = ray_tpu.get([idx.remote(i) for i in range(n)], timeout=1200)
+        dt = time.perf_counter() - t0
+        assert out[-1] == n - 1
+        return n / dt
+
+    queued_drain(512)  # warm pool + function table on both paths
+    # eager: lease cache off in THIS driver — every submit transits the
+    # head scheduler (the pre-fast-path control plane).  Wait out the
+    # warm-up's cached leases first: a held lease keeps its worker + CPU
+    # shape-hold away from the head until the idle timeout, which would
+    # skew the eager baseline (and the tracked speedup) in the fast
+    # path's favor.
+    RayConfig._values["lease_cache_enabled"] = False
+    from ray_tpu._private import worker as _worker_mod
+
+    _cw = _worker_mod.global_worker.core_worker
+    deadline = time.perf_counter() + RayConfig.lease_idle_timeout_s + 5
+    while time.perf_counter() < deadline and any(_cw._leases.values()):
+        time.sleep(0.1)
+    eager_drain = queued_drain(10_000)
+    print(f"queued 10k drain (eager): {eager_drain:,.1f} /s")
+    results["queued 10k drain (eager)"] = eager_drain
+    RayConfig._values["lease_cache_enabled"] = True
+    queued_drain(512)  # acquire the lease before the measured burst
+    lease_drain = queued_drain(10_000)
+    print(f"queued 10k drain (cached lease): {lease_drain:,.1f} /s")
+    results["queued 10k drain (cached lease)"] = lease_drain
+    results["lease drain vs eager speedup"] = lease_drain / eager_drain
+    print(f"lease drain vs eager speedup: {lease_drain / eager_drain:.1f}x")
+
+    n_actors = int(os.environ.get("RAY_PERF_ACTORS", "600"))
+    fleet = []
+    t0 = time.perf_counter()
+    batch = 50
+    while len(fleet) < n_actors:
+        fresh = [Actor.remote() for _ in range(min(batch, n_actors - len(fleet)))]
+        ray_tpu.get([a.ping.remote() for a in fresh], timeout=600)
+        fleet.extend(fresh)
+    create_dt = time.perf_counter() - t0
+    rate = len(fleet) / create_dt
+    print(f"actor create {n_actors}: {rate:,.1f} /s ({create_dt:.1f}s)")
+    results[f"actor create {n_actors} (actors/s)"] = rate
+    for a in fleet:
+        try:
+            ray_tpu.kill(a)
+        except Exception:  # graftlint: disable=silent-except -- best-effort teardown in a benchmark helper
+            pass
 
     print(json.dumps({k: round(v, 1) for k, v in results.items()}))
     ray_tpu.shutdown()

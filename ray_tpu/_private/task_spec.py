@@ -64,6 +64,14 @@ class TaskSpec:
     # normal tasks: preemptions tolerated before the return objects seal a
     # typed PreemptedError; -1 = RayConfig.task_preemption_budget
     max_preemptions: int = -1
+    # preemptions already suffered (carried across lease-revocation
+    # resubmits so the driver-side and head-side halves of the budget
+    # can never double-count from zero)
+    preempt_count: int = 0
+    # which grant path dispatched this task: "head" (scheduler loop),
+    # "cached_lease" (driver-held worker lease), or "raylet" (node-local
+    # grant).  Tags the flight-recorder queue-wait histograms.
+    granted_by: str = "head"
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     # set when the worker owning this actor should claim the real TPU chip
     claim_tpu: bool = False

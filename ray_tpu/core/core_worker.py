@@ -107,6 +107,85 @@ def _error_from_string(msg: str) -> Exception:
     return RaySystemError(msg)
 
 
+class _Lease:
+    """One cached worker lease (control-plane fast path): a direct
+    connection to a leased worker plus the in-flight task table.  All
+    mutable state is guarded by CoreWorker._lease_lock."""
+
+    __slots__ = (
+        "lease_id",
+        "worker_id",
+        "addr",
+        "conn",
+        "shape",
+        "node_id",
+        "granted_by",
+        "grantor",  # "head" | node_id bytes (raylet agent)
+        "pool",  # owning _LeasePool
+        "inflight",  # task_id -> {"wire": spec wire, "oids": [...], "t": push ts}
+        "revoked",
+        "returned",
+        "last_used",
+        "push_buffer",
+        "flush_scheduled",
+    )
+
+    def __init__(self, lease_id, worker_id, addr, conn, shape, node_id, granted_by, grantor, pool):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.addr = addr
+        self.conn = conn
+        self.shape = shape
+        self.node_id = node_id
+        self.granted_by = granted_by
+        self.grantor = grantor
+        self.pool = pool
+        self.inflight: Dict[bytes, dict] = {}
+        self.revoked = False
+        self.returned = False
+        self.last_used = time.time()
+        self.push_buffer: List[dict] = []
+        self.flush_scheduled = False
+
+
+class _LeasePool:
+    """All leases a client holds for one (shape, affinity, band), plus
+    the client-side dispatch queue over them.  The pump assigns
+    breadth-first (idle leases before deepening any queue) so wall-clock
+    parallelism survives, grows the pool toward the demand (up to
+    ``lease_max_per_shape``), bounds per-lease queue depth by the
+    observed task duration (``lease_queue_latency_budget_s`` /
+    EWMA: tiny tasks pipeline deep, long tasks spread), and overflows to
+    the head path when the pool is saturated and cannot grow — the head
+    stays the capacity authority."""
+
+    __slots__ = ("key", "leases", "queue", "growing", "ewma", "denied_at")
+
+    def __init__(self, key):
+        self.key = key
+        self.leases: List[_Lease] = []
+        from collections import deque
+
+        self.queue = deque()  # TaskSpec objects not yet assigned anywhere
+        self.growing = 0  # lease requests in flight
+        # observed mean task duration (push→done, seconds); optimistic
+        # start so unknown workloads pipeline a little, corrected by the
+        # first completions — overestimates (queue wait included) only
+        # push toward MORE breadth, the safe direction
+        self.ewma = 0.02
+        self.denied_at = 0.0
+
+    # tests/tooling treat the registry values as "the leases"
+    def __bool__(self):
+        return bool(self.leases)
+
+    def __len__(self):
+        return len(self.leases)
+
+    def __iter__(self):
+        return iter(self.leases)
+
+
 class _EventLoopThread:
     """Dedicated asyncio loop thread servicing the head connection."""
 
@@ -204,6 +283,21 @@ class CoreWorker:
         self._subscriptions: Dict[str, List[Callable[[dict], None]]] = {}
         self.connected = False
 
+        # --- worker-lease cache (control-plane fast path) ---
+        # (shape, node_affinity, band) -> _LeasePool: once leases for
+        # shape S are held, queues of S-shaped tasks push straight to the
+        # leased workers — no head round-trip per task
+        self._lease_lock = threading.Lock()
+        self._leases: Dict[tuple, _LeasePool] = {}
+        self._lease_by_id: Dict[bytes, _Lease] = {}
+        self._lease_gc_started = False
+        # raylet-local dispatch: node_id -> lease-agent conn (or False =
+        # known absent), discovered via LIST_NODES labels
+        self._node_agent_conn: Dict[bytes, Any] = {}
+        # GCS shard plane: one conn to a shard listener, dialed after
+        # registration; None means everything routes to the head
+        self._shard_conn: Optional[Connection] = None
+
         self.is_client = False  # remote driver without a local store mmap
         self._client_promoted: set = set()
         self._conn_lost = False
@@ -241,6 +335,39 @@ class CoreWorker:
 
     # ------------------------------------------------------------- plumbing
 
+    # message types the GCS shard listeners serve (gcs/shards.py); plus
+    # WAIT_OBJECT without a destination node and read-only ACTOR_STATE,
+    # decided per-payload in _conn_for
+    _SHARD_TYPES = frozenset(
+        {
+            MsgType.KV_PUT,
+            MsgType.KV_GET,
+            MsgType.KV_DEL,
+            MsgType.KV_KEYS,
+            MsgType.KV_EXISTS,
+            MsgType.GET_ACTOR,
+        }
+    )
+
+    def _conn_for(self, msg_type, payload) -> Connection:
+        """Route shard-servable RPCs off the head loop (KV, object-locate
+        waits, actor-directory reads); everything else — and everything
+        when no shard conn is up — goes to the head."""
+        sc = self._shard_conn
+        if sc is None or sc.closed:
+            return self.conn
+        if msg_type in self._SHARD_TYPES:
+            return sc
+        if (
+            msg_type == MsgType.WAIT_OBJECT
+            and payload.get("node_id") is None
+            and not payload.get("evicted")
+        ):
+            return sc
+        if msg_type == MsgType.ACTOR_STATE and payload.get("direct_addr") is None:
+            return sc
+        return self.conn
+
     def request(self, msg_type, payload, timeout: Optional[float] = None):
         """Synchronous control RPC from any thread.  Fails FAST with a
         typed HeadUnreachableError once the head connection is known dead
@@ -250,20 +377,58 @@ class CoreWorker:
             raise HeadUnreachableError(
                 f"head connection lost; {MsgType(msg_type).name} unavailable"
             )
+        conn = self._conn_for(msg_type, payload)
         try:
             return self.io.call(
-                self.conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
+                conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
             )
         except ConnectionError as e:
             # only transport loss converts: a remote ERROR_REPLY also
             # surfaces as ConnectionError but leaves the conn healthy
             if isinstance(e, HeadUnreachableError):
                 raise
+            if conn is not self.conn and conn.closed:
+                # shard listener gone: permanent fallback to the head (it
+                # keeps every handler), retrying this call there
+                self._shard_conn = None
+                return self.request(msg_type, payload, timeout)
             if self._conn_lost or self.conn.closed:
                 raise HeadUnreachableError(
                     f"head connection lost during {MsgType(msg_type).name}: {e}"
                 ) from e
             raise
+
+    def _dial_shard(self, addrs):
+        """Dial one GCS shard listener (picked by worker-id hash so
+        clients spread across shards); fire-and-forget — until it lands,
+        everything routes to the head."""
+        if not addrs or os.environ.get("RAY_TPU_NO_GCS_SHARDS"):
+            return
+        import zlib as _zlib
+
+        addr = addrs[_zlib.crc32(self.worker_id.binary()) % len(addrs)]
+        host, port_s = str(addr).rsplit(":", 1)
+
+        async def _dial():
+            try:
+                conn = await Connection.connect(host, int(port_s), 5, retry=False)
+            except Exception:  # graftlint: disable=silent-except -- shard plane is an offload; the head serves everything without it
+                return
+            self._shard_conn = conn
+
+            async def _read():
+                try:
+                    while True:
+                        mt, rid, pl = await conn.read_frame()
+                        conn.dispatch_reply(mt, rid, pl)
+                except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                    conn.close()
+                    if self._shard_conn is conn:
+                        self._shard_conn = None
+
+            asyncio.get_running_loop().create_task(_read())
+
+        self.io.spawn(_dial())
 
     async def _read_loop(self):
         try:
@@ -290,6 +455,10 @@ class CoreWorker:
                     # checkpoint request: __ray_save__ is user code — run
                     # it on its own thread, never on this io loop
                     self._on_preempt_request(rid, payload)
+                elif msg_type == MsgType.LEASE_REVOKE:
+                    # the head wants a cached lease back (preemption):
+                    # stop pushing, drain, return
+                    self._on_lease_revoke(payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             self._conn_lost = True
             self.connected = False
@@ -809,14 +978,15 @@ class CoreWorker:
                 # carries ALL currently-sealed ids, and the cv loop
                 # re-issues for the rest if still short.
                 rem_ = None if deadline is None else max(0.0, deadline - time.monotonic())
+                wait_payload = {
+                    "object_ids": ids,
+                    "num_ready": want,
+                    "timeout": rem_,
+                }
                 fut = self.io.spawn(
-                    self.conn.request(
+                    self._conn_for(MsgType.WAIT_OBJECT, wait_payload).request(
                         MsgType.WAIT_OBJECT,
-                        {
-                            "object_ids": ids,
-                            "num_ready": want,
-                            "timeout": rem_,
-                        },
+                        wait_payload,
                         (rem_ + 10) if rem_ is not None else 3600,
                     )
                 )
@@ -1011,6 +1181,10 @@ class CoreWorker:
                 int(max_preemptions) if max_preemptions is not None else -1
             ),
         )
+        # lease fast path first: an S-shaped lease in hand means this spec
+        # pushes straight to the leased worker — no head round-trip at all
+        if self._try_lease_submit(spec):
+            return [ObjectRef(oid, self) for oid in spec.return_object_ids()]
         # fire-and-forget on the ordered conn: queueing cannot fail in a
         # way the caller could act on (failures seal into the return
         # objects), and a sync round trip per submit would serialize
@@ -1158,6 +1332,485 @@ class CoreWorker:
             await self.conn.send(MsgType.SUBMIT_TASK, {"spec": batch[0]})
         else:
             await self.conn.send(MsgType.SUBMIT_TASKS, {"specs": batch})
+
+    # ------------------------------------- worker-lease cache (fast path)
+
+    def _try_lease_submit(self, spec: TaskSpec) -> bool:
+        """Route a plain normal task through the lease pool for its
+        resource shape.  Returns False (head path) for shapes we can't or
+        shouldn't lease: placement-group tasks (bundle accounting lives at
+        the head) and client mode (no store to read results from)."""
+        if not RayConfig.lease_cache_enabled or self.is_client:
+            return False
+        if spec.task_type != NORMAL_TASK or spec.pg_id:
+            return False
+        # the band is part of the shape: a high-band task must NEVER queue
+        # behind lower-band work on a lower-band lease — it takes its own
+        # lease (or the head path, where it can preempt)
+        key = (
+            tuple(sorted((spec.resources or {"CPU": 1.0}).items())),
+            bytes(spec.node_affinity) if spec.node_affinity else None,
+            int(spec.priority),
+        )
+        # return oids go direct-pending NOW, before the task is visible
+        # anywhere: a get() racing the pool's assign must wait on the
+        # event (set on completion, conn loss, OR head-path flush), never
+        # park in a head-side wait for a result that will arrive inline
+        oids = spec.return_object_ids()
+        for oid in oids:
+            self._direct_pending[oid] = threading.Event()
+        arg_ids = [bytes(a[2]) for a in spec.args if a[0] == ARG_REF]
+        arg_ids += [bytes(i) for i in (spec.nested_refs or ())]
+        if arg_ids:
+            self._direct_keepalive[spec.task_id] = [
+                ObjectRef(oid, self) for oid in arg_ids
+            ]
+        with self._lease_lock:
+            pool = self._leases.get(key)
+            if pool is None:
+                pool = self._leases[key] = _LeasePool(key)
+            pool.queue.append((spec, oids))
+        self._start_lease_gc()
+        self._pump_lease_pool(pool)
+        # the spec is now owned by the pool: it leaves via a lease push,
+        # a head-path flush, or a typed error — never silently
+        return True
+
+    def _pump_lease_pool(self, pool: _LeasePool):
+        """The client-side dispatcher over one lease pool.  Called on
+        every enqueue, completion, grant, denial, revoke, and conn loss;
+        assigns breadth-first, grows on demand, deepens within the
+        latency budget, and overflows to the head when saturated."""
+        flush: List[TaskSpec] = []
+        touched: List[_Lease] = []
+        grow = False
+        with self._lease_lock:
+            live = [l for l in pool.leases if not l.revoked and not l.conn.closed]
+            pool.leases = live
+            cap = max(
+                1,
+                min(
+                    512,
+                    int(
+                        RayConfig.lease_queue_latency_budget_s
+                        / max(pool.ewma, 1e-4)
+                    ),
+                ),
+            )
+            while pool.queue:
+                lease = min(live, key=lambda l: len(l.inflight)) if live else None
+                out = len(lease.inflight) if lease is not None else 0
+                if lease is not None and out == 0:
+                    # breadth first: an idle lease always takes the task
+                    self._assign_to_lease(lease, *pool.queue.popleft())
+                    touched.append(lease)
+                    continue
+                can_grow = (
+                    len(live) + pool.growing < RayConfig.lease_max_per_shape
+                    and time.monotonic() - pool.denied_at
+                    >= RayConfig.lease_request_retry_s
+                )
+                if can_grow:
+                    # hold the rest until the grant (or denial) re-pumps:
+                    # deepening now would serialize work that could run in
+                    # parallel on the incoming lease
+                    pool.growing += 1
+                    grow = True
+                    break
+                if pool.growing:
+                    break  # a grant/denial in flight will re-pump
+                if lease is not None and out < cap:
+                    # can't grow: pipeline within the latency budget
+                    self._assign_to_lease(lease, *pool.queue.popleft())
+                    touched.append(lease)
+                    continue
+                if live:
+                    # saturated at the depth budget: the pool already holds
+                    # all the capacity a grant would give us — hold; every
+                    # completion (and the gc tick) re-pumps with a fresher
+                    # duration estimate
+                    break
+                # lease-less and can't grow: the head owns capacity — let
+                # it spread/spawn/preempt as it sees fit
+                flush = list(pool.queue)
+                pool.queue.clear()
+                break
+        for lease in touched:
+            with self._lease_lock:
+                if lease.flush_scheduled:
+                    continue
+                lease.flush_scheduled = True
+            self.io.spawn(self._flush_lease_pushes(lease))
+        if grow:
+            threading.Thread(
+                target=self._grow_pool, args=(pool,), daemon=True
+            ).start()
+        for spec, oids in flush:
+            # hand the task to the head (which pins args at submit), then
+            # release the direct registration: waiters wake, find nothing
+            # local, and fall through to the head-side wait
+            self._direct_keepalive.pop(spec.task_id, None)
+            self._enqueue_submit(spec)
+            for oid in oids:
+                ev = self._direct_pending.pop(bytes(oid), None)
+                if ev is not None:
+                    ev.set()
+                self._fire_done_callbacks(bytes(oid))
+        if flush:
+            with self._direct_cv:
+                self._direct_cv.notify_all()
+
+    def _grow_pool(self, pool: _LeasePool):
+        """Worker thread: one lease request for the pool (sync RPCs —
+        never on the io loop), then re-pump whatever the outcome."""
+        try:
+            self._request_lease(pool)
+        finally:
+            with self._lease_lock:
+                pool.growing = max(0, pool.growing - 1)
+            self._pump_lease_pool(pool)
+
+    def _request_lease(self, pool: _LeasePool) -> Optional[_Lease]:
+        shape, affinity, band = pool.key
+        try:
+            payload = {
+                "resources": dict(shape),
+                "priority": int(band),
+            }
+            reply = None
+            granted_by = "cached_lease"
+            grantor: Any = "head"
+            if affinity:
+                payload["node_id"] = affinity
+                agent = self._agent_conn_for(affinity)
+                if agent is not None:
+                    try:
+                        reply = self.io.call(
+                            agent.request(MsgType.LEASE_REQUEST, payload, 5), 10
+                        )
+                        if reply.get("granted"):
+                            granted_by = "raylet"
+                            grantor = affinity
+                    except Exception:  # graftlint: disable=silent-except -- local agent unreachable; the head grant below still works
+                        reply = None
+            if reply is None or not reply.get("granted"):
+                reply = self.request(MsgType.LEASE_REQUEST, payload, timeout=10)
+                granted_by = "cached_lease"
+                grantor = "head"
+            if not reply.get("granted"):
+                pool.denied_at = time.monotonic()
+                return None
+            host, port_s = str(reply["addr"]).rsplit(":", 1)
+            conn = self.io.call(
+                Connection.connect(
+                    host, int(port_s), RayConfig.connect_timeout_s, retry=False
+                )
+            )
+            lease = _Lease(
+                bytes(reply["lease_id"]),
+                bytes(reply["worker_id"]),
+                str(reply["addr"]),
+                conn,
+                shape,
+                bytes(reply.get("node_id") or b""),
+                granted_by,
+                grantor,
+                pool,
+            )
+            with self._lease_lock:
+                pool.leases.append(lease)
+                self._lease_by_id[lease.lease_id] = lease
+                pool.denied_at = 0.0
+            self.io.spawn(self._lease_read_loop(lease))
+            return lease
+        except Exception:  # graftlint: disable=silent-except -- lease path is an optimization; submits fall back to the head
+            pool.denied_at = time.monotonic()
+            return None
+
+    def _agent_conn_for(self, node_id: bytes) -> Optional[Connection]:
+        """Conn to node_id's raylet lease agent, discovered via the node
+        table (label ``dispatch_addr``); False-cached when absent."""
+        if not RayConfig.raylet_local_dispatch:
+            return None
+        cached = self._node_agent_conn.get(node_id)
+        if cached is False:
+            return None
+        if cached is not None and not cached.closed:
+            return cached
+        addr = ""
+        try:
+            for n in self.list_nodes():
+                if bytes(n["node_id"]) == bytes(node_id):
+                    addr = (n.get("labels") or {}).get("dispatch_addr", "")
+                    break
+        except Exception:  # graftlint: disable=silent-except -- discovery failure falls back to head grants
+            return None
+        if not addr:
+            self._node_agent_conn[node_id] = False
+            return None
+        try:
+            host, port_s = addr.rsplit(":", 1)
+            conn = self.io.call(
+                Connection.connect(host, int(port_s), 5, retry=False)
+            )
+        except Exception:  # graftlint: disable=silent-except -- unreachable agent negative-caches; head grants still work
+            self._node_agent_conn[node_id] = False
+            return None
+
+        async def _read():
+            try:
+                while True:
+                    mt, rid, pl = await conn.read_frame()
+                    if conn.dispatch_reply(mt, rid, pl):
+                        continue
+                    if mt == MsgType.LEASE_REVOKE:
+                        self._on_lease_revoke(pl)
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                conn.close()
+
+        self.io.spawn(_read())
+        self._node_agent_conn[node_id] = conn
+        return conn
+
+    def _assign_to_lease(self, lease: _Lease, spec: TaskSpec, oids):
+        """Bind one queued task to a lease (caller holds _lease_lock):
+        stage the wire for the next batched LEASE_PUSH flush.  The
+        direct-pending events and arg keepalives were registered at
+        enqueue (the head never sees this task — the caller's local
+        handles pin its ref args, the direct-call contract)."""
+        spec.granted_by = lease.granted_by
+        now = time.time()
+        if spec.phases is not None:
+            # the lease IS the grant: enqueue and dispatch collapse into
+            # the push instant (queue_wait ~0 — the point of the cache)
+            spec.phases["head_enqueue"] = now
+            spec.phases["dispatch"] = now
+        wire = spec.to_wire()
+        lease.inflight[spec.task_id] = {"wire": wire, "oids": oids, "t": now}
+        lease.push_buffer.append(wire)
+        lease.last_used = now
+
+    async def _flush_lease_pushes(self, lease: _Lease):
+        """Coalesced LEASE_PUSH: drains whatever accumulated by the time
+        the io loop runs this (same discipline as _flush_submits)."""
+        with self._lease_lock:
+            batch, lease.push_buffer = lease.push_buffer, []
+            lease.flush_scheduled = False
+        if not batch:
+            return
+        try:
+            await lease.conn.send(MsgType.LEASE_PUSH, {"specs": batch})
+        except Exception:  # graftlint: disable=silent-except -- conn loss recovery (resubmit / typed errors) lives in the read loop's finally
+            lease.conn.close()
+
+    async def _lease_read_loop(self, lease: _Lease):
+        try:
+            while True:
+                msg_type, rid, payload = await lease.conn.read_frame()
+                if lease.conn.dispatch_reply(msg_type, rid, payload):
+                    continue
+                if msg_type == MsgType.LEASE_DONE:
+                    self._on_lease_done(lease, payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            lease.conn.close()
+            self._on_lease_conn_lost(lease)
+
+    def _on_lease_done(self, lease: _Lease, payload: dict):
+        drained = False
+        now = time.time()
+        for result in payload.get("results", []):
+            tid = bytes(result.get("task_id") or b"")
+            with self._lease_lock:
+                entry = lease.inflight.pop(tid, None)
+                drained = lease.revoked and not lease.inflight
+                if entry is not None:
+                    # mean task duration feeds the pool's depth budget;
+                    # queue wait inflates the sample, which only pushes
+                    # toward MORE breadth — the safe direction
+                    sample = max(1e-5, now - entry.get("t", now))
+                    lease.pool.ewma = 0.8 * lease.pool.ewma + 0.2 * sample
+            if entry is None:
+                continue
+            for oid, wire in (result.get("inline") or {}).items():
+                self._memory_store[bytes(oid)] = SerializedObject.from_wire(wire)
+            self._direct_keepalive.pop(tid, None)
+            for oid in entry["oids"]:
+                ev = self._direct_pending.pop(bytes(oid), None)
+                if ev is not None:
+                    ev.set()
+                self._fire_done_callbacks(bytes(oid))
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        if drained:
+            # revoked lease fully drained: hand it back now — every pushed
+            # task ran exactly once, nothing to resubmit
+            self._finalize_lease_return(lease)
+        else:
+            self._pump_lease_pool(lease.pool)
+
+    def _on_lease_revoke(self, payload: dict):
+        """LEASE_REVOKE push (head or raylet agent): stop using the lease;
+        return it once the in-flight tail drains (or immediately when
+        idle).  Tasks already pushed keep running on the still-alive
+        worker — revocation must not double-execute them."""
+        lease = self._lease_by_id.get(bytes(payload.get("lease_id") or b""))
+        if lease is None:
+            return
+        with self._lease_lock:
+            lease.revoked = True
+            idle = not lease.inflight and not lease.push_buffer
+            if lease in lease.pool.leases:
+                lease.pool.leases.remove(lease)
+        if idle:
+            self._finalize_lease_return(lease)
+        self._pump_lease_pool(lease.pool)
+
+    def _on_lease_conn_lost(self, lease: _Lease):
+        """The leased worker (or its socket) died.  Revoked leases were
+        preempted: unreplied pushes resubmit on the PREEMPTION budget and
+        seal a typed PreemptedError once it's spent.  Otherwise it's a
+        fault: resubmit on the retry budget, WorkerCrashedError when
+        exhausted."""
+        with self._lease_lock:
+            if lease in lease.pool.leases:
+                lease.pool.leases.remove(lease)
+            self._lease_by_id.pop(lease.lease_id, None)
+            pending = list(lease.inflight.items())
+            lease.inflight.clear()
+        for tid, entry in pending:
+            wire = entry["wire"]
+            self._direct_keepalive.pop(tid, None)
+            if lease.revoked:
+                pc = int(wire.get("preempt_count", 0)) + 1
+                budget = (
+                    int(wire.get("max_preemptions", -1))
+                    if int(wire.get("max_preemptions", -1)) >= 0
+                    else RayConfig.task_preemption_budget
+                )
+                if pc > budget:
+                    self._seal_local_error(
+                        entry["oids"],
+                        wire,
+                        PreemptedError(
+                            "preempted by higher-priority work (lease revoked)",
+                            pc,
+                            budget,
+                        ),
+                    )
+                    continue
+                wire["preempt_count"] = pc
+            else:
+                rl = int(wire.get("retries_left", 0))
+                if rl <= 0:
+                    self._seal_local_error(
+                        entry["oids"],
+                        wire,
+                        WorkerCrashedError(
+                            "leased worker died while running "
+                            f"{wire.get('function_name') or 'task'}"
+                        ),
+                    )
+                    continue
+                wire["retries_left"] = rl - 1
+            # resubmit through the head: it owns placement from here
+            wire["granted_by"] = "head"
+            self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": wire}))
+        # wake waiters AFTER the resubmits are queued on the ordered conn:
+        # their follow-up WAIT_OBJECT can then never race ahead of the
+        # resubmit frame
+        for tid, entry in pending:
+            for oid in entry["oids"]:
+                ev = self._direct_pending.pop(bytes(oid), None)
+                if ev is not None:
+                    ev.set()
+                self._fire_done_callbacks(bytes(oid))
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        if lease.revoked and not lease.returned:
+            # killed mid-revoke (deadline escalation): the grantor's
+            # worker-death path reclaimed the resources; nothing to return
+            lease.returned = True
+        # tasks still waiting in the pool queue re-route (fresh lease or
+        # head path)
+        self._pump_lease_pool(lease.pool)
+
+    def _seal_local_error(self, oids, wire, cause: Exception):
+        err = serialization.serialize(
+            RayTaskError(
+                str(wire.get("function_name") or "task"),
+                str(cause),
+                cause=cause,
+            )
+        )
+        for oid in oids:
+            self._memory_store[bytes(oid)] = err
+
+    def _finalize_lease_return(self, lease: _Lease):
+        with self._lease_lock:
+            if lease.returned:
+                return
+            if not lease.revoked and (lease.inflight or lease.push_buffer):
+                # the idle-GC scan and this finalize are not atomic: a
+                # submit can assign work in between.  A live lease with
+                # work keeps running — returning it here would close the
+                # push conn under a pushed task (double execution via the
+                # conn-loss resubmit, or a spurious WorkerCrashedError)
+                return
+            lease.returned = True
+            self._lease_by_id.pop(lease.lease_id, None)
+            if lease in lease.pool.leases:
+                lease.pool.leases.remove(lease)
+        payload = {"lease_id": lease.lease_id}
+        try:
+            if lease.grantor == "head":
+                self.io.spawn(self.conn.send(MsgType.LEASE_RETURN, payload))
+            else:
+                agent = self._node_agent_conn.get(lease.grantor)
+                if agent and not agent.closed:
+                    self.io.spawn(agent.send(MsgType.LEASE_RETURN, payload))
+                else:
+                    self.io.spawn(self.conn.send(MsgType.LEASE_RETURN, payload))
+        except Exception:  # graftlint: disable=silent-except -- grantor conn gone; its disconnect path reclaims the lease
+            pass
+        self.io.loop.call_soon_threadsafe(lease.conn.close)
+
+    def _start_lease_gc(self):
+        with self._lease_lock:
+            if self._lease_gc_started:
+                return
+            self._lease_gc_started = True
+
+        async def _gc():
+            while True:
+                await asyncio.sleep(
+                    max(0.25, RayConfig.lease_idle_timeout_s / 4)
+                )
+                now = time.time()
+                idle: List[_Lease] = []
+                stalled: List[_LeasePool] = []
+                with self._lease_lock:
+                    for pool in self._leases.values():
+                        if pool.queue:
+                            stalled.append(pool)  # re-pump below, not idle
+                            continue
+                        for lease in pool.leases:
+                            if (
+                                not lease.inflight
+                                and not lease.push_buffer
+                                and now - lease.last_used
+                                > RayConfig.lease_idle_timeout_s
+                            ):
+                                idle.append(lease)
+                for pool in stalled:
+                    # a held queue re-evaluates periodically: the grow
+                    # deny-window may have lapsed, or capacity returned
+                    self._pump_lease_pool(pool)
+                for lease in idle:
+                    self._finalize_lease_return(lease)
+
+        self.io.spawn(_gc())
 
     # -------------------------------------------------- direct actor calls
 
@@ -1317,8 +1970,9 @@ class CoreWorker:
 
     async def _watch_object(self, oid: bytes):
         try:
-            await self.conn.request(
-                MsgType.WAIT_OBJECT, {"object_id": oid, "timeout": None}, 3600
+            payload = {"object_id": oid, "timeout": None}
+            await self._conn_for(MsgType.WAIT_OBJECT, payload).request(
+                MsgType.WAIT_OBJECT, payload, 3600
             )
         except Exception:  # graftlint: disable=silent-except -- watch is best-effort; callbacks fire regardless so waiters re-check the store
             pass
@@ -1564,7 +2218,13 @@ class CoreWorker:
         for payload in early:
             handler(payload)
 
-    def register_as_worker(self, node_id: bytes, pid: int, has_tpu: bool = False):
+    def register_as_worker(
+        self,
+        node_id: bytes,
+        pid: int,
+        has_tpu: bool = False,
+        direct_addr: str = "",
+    ):
         reply = self.request(
             MsgType.REGISTER_WORKER,
             {
@@ -1572,10 +2232,12 @@ class CoreWorker:
                 "node_id": node_id,
                 "pid": pid,
                 "has_tpu": has_tpu,
+                "direct_addr": direct_addr,
             },
         )
         self.node_id = node_id
         self.attach_store(reply["store_path"])
+        self._dial_shard(reply.get("shard_addrs") or [])
         return reply
 
     def register_as_driver(self, worker_env: Dict[str, str]):
@@ -1596,6 +2258,7 @@ class CoreWorker:
             # remote driver (Ray-Client mode, reference: util/client/): no
             # node store to mmap — object payloads ride the head connection
             self.is_client = True
+        self._dial_shard(reply.get("shard_addrs") or [])
         return reply
 
     def task_done(
@@ -1643,6 +2306,28 @@ class CoreWorker:
             except (OSError, RuntimeError):
                 pass  # already-dead transport; disconnect continues
         self._direct_conns.clear()
+        # cached leases die with the driver: the head reclaims them on the
+        # conn drop; close the push conns so leased workers stop waiting
+        with self._lease_lock:
+            leases = list(self._lease_by_id.values())
+            self._lease_by_id.clear()
+            self._leases.clear()
+        for lease in leases:
+            try:
+                lease.conn.close()
+            except (OSError, RuntimeError):
+                pass  # already-dead transport; disconnect continues
+        for c in list(self._node_agent_conn.values()):
+            if c and c is not False:
+                try:
+                    c.close()
+                except (OSError, RuntimeError):
+                    pass  # already-dead transport; disconnect continues
+        if self._shard_conn is not None:
+            try:
+                self._shard_conn.close()
+            except (OSError, RuntimeError):
+                pass  # already-dead transport; disconnect continues
         try:
             self.conn.close()
         except (OSError, RuntimeError):

@@ -46,10 +46,23 @@ class WorkerRuntime:
         self.cancelled: set = set()
         self._concurrency_sem: Optional[threading.Semaphore] = None
         self._direct_server = None
+        self._direct_port = 0
         # mutual exclusion between eager actor calls and compiled-DAG
         # executor steps (ray_tpu/dag/): a sequential actor keeps its
         # one-call-at-a-time contract across both modes
         self.actor_lock = threading.Lock()
+        # lease fast path (control plane): batched completion frames per
+        # holder conn + batched flight records to the head.  Flushing is
+        # an io-loop TIMER (~2ms coalescing window), never the run
+        # thread: a completed result must reach the holder even while the
+        # NEXT task blocks in user code or arg resolution — holding it
+        # until the queue drains deadlocks consumer tasks waiting on the
+        # unflushed result.
+        self._lease_out_lock = threading.Lock()
+        self._lease_outbox: Dict[int, list] = {}  # id(conn) -> results
+        self._lease_conns: Dict[int, Any] = {}
+        self._stats_buffer: List[dict] = []
+        self._lease_flush_armed = False
         # calls between dequeue and their TASK_DONE flush: the actor_lock
         # covers only user code, so the preemption fence must ALSO wait
         # for this to reach zero — a call whose completion report is
@@ -82,6 +95,12 @@ class WorkerRuntime:
                 continue
             spec = TaskSpec.from_wire(payload["spec"])
             reply_to = payload.get("direct")
+            if payload.get("lease") is not None:
+                # lease-pushed normal task: execute serially (one lease =
+                # one concurrent task of shape S); completions flush on
+                # the io-loop timer armed by _queue_lease_result
+                self._execute_guarded(spec, ("lease", payload["lease"]))
+                continue
             if spec.task_type == ACTOR_TASK and self._concurrency_sem is None:
                 # sequential actor: enforce per-caller seq order so calls
                 # that raced the head→direct routing transition still run
@@ -149,6 +168,82 @@ class WorkerRuntime:
         if payload.get("directive"):
             return  # spawn directives are raylet business, not ours
         self.task_queue.put(payload)
+
+    # --------------------------------------- lease fast path (batched IO)
+
+    # coalescing window for completion/stats frames: everything that
+    # finishes within it rides one frame, and a result is never held
+    # hostage by the NEXT task's execution
+    _LEASE_FLUSH_WINDOW_S = 0.002
+
+    def _queue_lease_result(self, conn, spec: TaskSpec, inline, sealed, ph):
+        """Accumulate one lease-task completion for the holder + one
+        flight record for the head, and arm the io-loop flush timer."""
+        import time as _time
+
+        cid = id(conn)
+        with self._lease_out_lock:
+            self._lease_conns[cid] = conn
+            self._lease_outbox.setdefault(cid, []).append(
+                {"task_id": spec.task_id, "inline": inline, "stored": sealed}
+            )
+            if ph is not None:
+                ph.setdefault("done", _time.time())
+                self._stats_buffer.append(
+                    {
+                        "task_id": spec.task_id,
+                        "name": spec.function_name or spec.method_name or "task",
+                        "granted_by": getattr(spec, "granted_by", "cached_lease"),
+                        "phases": ph,
+                        "pid": os.getpid(),
+                    }
+                )
+            if self._lease_flush_armed:
+                return
+            self._lease_flush_armed = True
+
+        async def _later():
+            import asyncio
+
+            await asyncio.sleep(self._LEASE_FLUSH_WINDOW_S)
+            with self._lease_out_lock:
+                self._lease_flush_armed = False
+            self._flush_lease_batches()
+
+        try:
+            self.cw.io.spawn(_later())
+        except Exception:  # graftlint: disable=silent-except -- io loop gone (shutdown); the inline flush below is the recovery
+            with self._lease_out_lock:
+                self._lease_flush_armed = False
+            self._flush_lease_batches()
+
+    def _flush_lease_batches(self):
+        from ray_tpu._private.protocol import MsgType
+
+        with self._lease_out_lock:
+            batches = {
+                cid: results
+                for cid, results in self._lease_outbox.items()
+                if results
+            }
+            for cid in batches:
+                self._lease_outbox[cid] = []
+            stats, self._stats_buffer = self._stats_buffer, []
+        for cid, results in batches.items():
+            conn = self._lease_conns.get(cid)
+            if conn is None or conn.closed:
+                continue  # holder gone: its conn-loss path owns recovery
+            self.cw.io.spawn(conn.send(MsgType.LEASE_DONE, {"results": results}))
+        if stats:
+            try:
+                self.cw.io.spawn(
+                    self.cw.conn.send(
+                        MsgType.TASK_STATS,
+                        {"node_id": self.cw.node_id, "records": stats},
+                    )
+                )
+            except Exception:  # graftlint: disable=silent-except -- stats are best-effort observability; the completions above are what correctness needs
+                pass
 
     def on_preempt(self, payload: dict) -> dict:
         """Checkpoint request from the head's preemptive scheduler
@@ -227,6 +322,55 @@ class WorkerRuntime:
                     return False
                 self._inflight_cv.wait(rem)
             return True
+
+    def register_with_lease_agent(self, agent_addr: str, direct_port: int):
+        """Announce this worker to its node's raylet lease agent
+        (raylet/lease_agent.py) so node-affine leases grant locally.  The
+        connection doubles as the liveness signal: the agent forgets the
+        worker when it drops."""
+        from ray_tpu._private.config import RayConfig
+        from ray_tpu._private.protocol import Connection, MsgType
+
+        host, port_s = agent_addr.rsplit(":", 1)
+
+        async def _register():
+            conn = await Connection.connect(
+                host, int(port_s), RayConfig.connect_timeout_s, retry=False
+            )
+            await conn.send(
+                MsgType.REGISTER_WORKER,
+                {
+                    "worker_id": self.cw.worker_id.binary(),
+                    "pid": os.getpid(),
+                    "direct_addr": f"0.0.0.0:{direct_port}",
+                    "has_tpu": bool(os.environ.get("RAY_TPU_WORKER_TPU")),
+                },
+            )
+            return conn
+
+        try:
+            self._agent_conn = self.cw.io.call(_register(), timeout=10)
+        except Exception:  # noqa: BLE001 -- local dispatch is an optimization; head grants still work
+            traceback.print_exc(file=sys.stderr)
+            self._agent_conn = None
+
+    def _notify_agent_dedicated(self):
+        """Tell the lease agent this worker now belongs to an actor and
+        must never be leased."""
+        conn = getattr(self, "_agent_conn", None)
+        if conn is None or conn.closed:
+            return
+        from ray_tpu._private.protocol import MsgType
+
+        try:
+            self.cw.io.spawn(
+                conn.send(
+                    MsgType.REGISTER_WORKER,
+                    {"worker_id": self.cw.worker_id.binary(), "dedicated": True},
+                )
+            )
+        except Exception:  # graftlint: disable=silent-except -- best-effort; the agent also learns via lease-push failures
+            pass
 
     def dag_runtime(self):
         """Lazy compiled-DAG runtime (ray_tpu/dag/executor.py) — created on
@@ -330,6 +474,7 @@ class WorkerRuntime:
         finally:
             self.cw.current_task_id = None
         if direct:
+            lease_mode = reply_to[0] == "lease"
             # over-limit / ref-containing results were stored: seal them at
             # the head first, then answer the caller (inline errors raise
             # client-side on deserialize, like stored ones)
@@ -343,7 +488,10 @@ class WorkerRuntime:
                         exec_start=exec_start,
                         exec_end=_time.time(),
                         contained=contained,
-                        phases=ph,
+                        # lease records ship on the batched TASK_STATS
+                        # plane instead (tagged granted_by) — stamping
+                        # both would double-count the flight recorder
+                        phases=None if lease_mode else ph,
                     )
             except Exception:
                 traceback.print_exc(file=sys.stderr)
@@ -353,6 +501,9 @@ class WorkerRuntime:
             # head-visible pin via its arg keepalives) sees the reply and
             # releases, or the late add resurrects a freed count
             self.cw.flush_ref_adds()
+            if lease_mode:
+                self._queue_lease_result(reply_to[1], spec, inline, sealed, ph)
+                return
             conn, rid = reply_to
             self.cw.io.spawn(
                 conn.reply(rid, {"inline": inline, "stored": sealed})
@@ -435,6 +586,7 @@ class WorkerRuntime:
                 self._concurrency_sem = threading.Semaphore(concurrency)
             if ph is not None:
                 ph["arg_fetch_end"] = ph["exec_start"] = _time.time()
+            self._notify_agent_dedicated()  # actor workers are never leased
             self.actor.instance = cls(*args, **kwargs)
             if spec.preemptible:
                 # respawn-with-restore: a checkpoint saved by a prior
@@ -527,18 +679,19 @@ class WorkerRuntime:
         t = threading.Thread(target=loop.run_forever, name="actor-async", daemon=True)
         t.start()
 
-    def _start_direct_server(self, actor_id: bytes):
-        """Listen for direct actor calls from other workers/drivers — the
-        worker→worker data path that keeps the head out of the per-call
-        loop (reference analog: CoreWorker's PushTask gRPC service consumed
-        by DirectActorSubmitter, direct_actor_task_submitter.cc)."""
+    def ensure_direct_server(self) -> int:
+        """Start (once) this worker's direct-call server and return its
+        port — the worker→worker/driver data path that keeps the head out
+        of the per-call loop (reference analog: CoreWorker's PushTask gRPC
+        service, direct_actor_task_submitter.cc).  Every worker runs one
+        now, not just actors: the lease fast path pushes whole task queues
+        here (LEASE_PUSH), so the address rides worker registration."""
         import asyncio
 
-        from ray_tpu._private.config import RayConfig
         from ray_tpu._private.protocol import Connection, MsgType
 
-        if not RayConfig.enable_direct_actor_calls:
-            return
+        if self._direct_server is not None:
+            return self._direct_port
 
         async def _serve(reader, writer):
             conn = Connection(reader, writer)
@@ -549,6 +702,11 @@ class WorkerRuntime:
                         self.task_queue.put(
                             {"spec": payload["spec"], "direct": (conn, rid)}
                         )
+                    elif msg_type == MsgType.LEASE_PUSH:
+                        # a lease holder's batched task queue: O(1) enqueue
+                        # per spec, completions batch back on LEASE_DONE
+                        for wire in payload.get("specs", []):
+                            self.task_queue.put({"spec": wire, "lease": conn})
                     elif msg_type == MsgType.DAG_PUSH:
                         # compiled-step doorbell: O(1) enqueue to the node's
                         # channel, the resident executor thread does the rest
@@ -605,7 +763,24 @@ class WorkerRuntime:
             return port
 
         try:
-            port = self.cw.io.call(_start(), timeout=10)
+            self._direct_port = self.cw.io.call(_start(), timeout=10)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)  # head path keeps working
+            self._direct_port = 0
+        return self._direct_port
+
+    def _start_direct_server(self, actor_id: bytes):
+        """Announce this actor's direct-call endpoint to the head (the
+        server itself is the shared per-worker one)."""
+        from ray_tpu._private.config import RayConfig
+        from ray_tpu._private.protocol import MsgType
+
+        if not RayConfig.enable_direct_actor_calls:
+            return
+        port = self.ensure_direct_server()
+        if not port:
+            return
+        try:
             self.cw.request(
                 MsgType.ACTOR_STATE,
                 {"actor_id": actor_id, "direct_addr": f"0.0.0.0:{port}"},
@@ -622,6 +797,17 @@ def _is_async_actor(cls) -> bool:
 
 
 def main():
+    # stack dumps on demand: `kill -USR1 <worker pid>` writes every
+    # thread's traceback to the worker log — the first tool for "which
+    # worker is wedged, and where" at fleet scale
+    import faulthandler
+    import signal as _signal
+
+    try:
+        faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError, OSError):
+        pass  # non-main thread / unsupported platform: debugging aid only
+
     host, port = os.environ["RAY_TPU_HEAD"].split(":")
     node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
     from ray_tpu._private.config import RayConfig
@@ -637,9 +823,22 @@ def main():
     # the moment registration lands
     cw.set_push_task_handler(runtime.on_push)
     cw.set_preempt_handler(runtime.on_preempt)
+    # every worker serves direct calls now (lease pushes + actor calls);
+    # the address rides registration so the head can grant leases on it
+    direct_port = 0
+    if RayConfig.enable_direct_actor_calls or RayConfig.lease_cache_enabled:
+        direct_port = runtime.ensure_direct_server()
     cw.register_as_worker(
-        node_id, os.getpid(), has_tpu=bool(os.environ.get("RAY_TPU_WORKER_TPU"))
+        node_id,
+        os.getpid(),
+        has_tpu=bool(os.environ.get("RAY_TPU_WORKER_TPU")),
+        direct_addr=f"0.0.0.0:{direct_port}" if direct_port else "",
     )
+    # node-local dispatch: announce to this node's raylet lease agent (if
+    # any) so node-affine leases grant without a head round-trip
+    agent_addr = os.environ.get("RAY_TPU_RAYLET_DISPATCH", "")
+    if agent_addr and direct_port:
+        runtime.register_with_lease_agent(agent_addr, direct_port)
 
     # mark this process as a connected worker for nested API calls
     from ray_tpu._private import worker as worker_mod

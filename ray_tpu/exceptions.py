@@ -61,6 +61,11 @@ class RayTaskError(RayError):
                 {"__init__": lambda s: None},
             )
             err = derived()
+            # the cause's own payload first (e.g. PreemptedError.attempt/
+            # .budget), so typed handlers can read its fields off the
+            # derived instance; the RayTaskError envelope fields win
+            for k, v in vars(cause).items():
+                setattr(err, k, v)
             err.function_name = self.function_name
             err.traceback_str = self.traceback_str
             err.cause = cause

@@ -89,6 +89,8 @@ class WorkerInfo:
         "idle_since",
         "dedicated",
         "has_tpu",
+        "direct_addr",
+        "lease",
     )
 
     def __init__(
@@ -105,6 +107,12 @@ class WorkerInfo:
         self.idle_since = time.time()
         self.dedicated = False  # actor-dedicated workers never return to pool
         self.has_tpu = has_tpu  # spawned with the TPU claim env intact
+        # dialable host:port of the worker's direct-call server (every
+        # worker runs one now — the lease fast path pushes tasks here)
+        self.direct_addr = ""
+        # active worker lease (control-plane fast path): {"lease_id",
+        # "cid", "resources", "priority", "via", "granted_at", "revoking"}
+        self.lease: Optional[dict] = None
 
 
 class NodeInfo:
@@ -125,6 +133,7 @@ class NodeInfo:
         "address",
         "transfer_addr",
         "store_stats",
+        "idle_pool",
         "_sched",
     )
 
@@ -142,6 +151,10 @@ class NodeInfo:
         self.store_path = store_path
         self.alive = True
         self.workers: Dict[bytes, WorkerInfo] = {}
+        # O(1) idle-worker index, split by TPU claim: _find_idle_worker /
+        # the scheduler's capacity count were O(total workers) per call,
+        # which is what made 600-actor fleets quadratic at the head
+        self.idle_pool: Dict[bool, Set[bytes]] = {False: set(), True: set()}
         self.starting_workers = 0
         self.labels: Dict[str, str] = {}
         self.address = ""
@@ -183,6 +196,33 @@ class NodeInfo:
 
     def utilization(self) -> float:
         return self._sched.utilization(self.node_id)
+
+    # ---- idle-worker index (kept in lockstep with WorkerInfo.idle) ----
+
+    def mark_idle(self, w: "WorkerInfo"):
+        w.idle = True
+        w.idle_since = time.time()
+        if not w.dedicated and w.actor_id is None and w.lease is None:
+            self.idle_pool[w.has_tpu].add(w.worker_id)
+
+    def mark_busy(self, w: "WorkerInfo"):
+        w.idle = False
+        self.idle_pool[w.has_tpu].discard(w.worker_id)
+
+    def forget_worker(self, w: "WorkerInfo"):
+        self.workers.pop(w.worker_id, None)
+        self.idle_pool[w.has_tpu].discard(w.worker_id)
+
+    def pop_idle(self, needs_tpu: bool) -> Optional["WorkerInfo"]:
+        pool = self.idle_pool[needs_tpu]
+        while pool:
+            wid = next(iter(pool))
+            pool.discard(wid)
+            w = self.workers.get(wid)
+            if w is not None and w.idle and w.actor_id is None and not w.dedicated and w.lease is None:
+                w.idle = False
+                return w
+        return None
 
 
 class ActorInfo:
@@ -264,9 +304,11 @@ class TaskEntry:
         self.enqueued_at = time.time()
         # preemption accounting: the scheduler killed this running task by
         # policy (requeue, don't charge the fault-retry budget); the count
-        # seals a typed PreemptedError once the preemption budget is spent
+        # seals a typed PreemptedError once the preemption budget is spent.
+        # Seeded from the spec so preemptions a task already suffered on a
+        # revoked lease (driver-side resubmit) stay on the same budget.
         self.preempted = False
-        self.preempt_count = 0
+        self.preempt_count = int(getattr(spec, "preempt_count", 0) or 0)
         self.preempt_requested_at = 0.0  # rate-limits victim scans per entry
         # the submit frame's wire form, reused verbatim for the PUSH_TASK
         # dispatch — re-encoding the spec per hop was measurable on the
@@ -332,14 +374,29 @@ class HeadServer:
         self._lineage_total = 0
         self._reconstructions: Dict[bytes, int] = {}
 
-        self.kv: Dict[str, bytes] = {}
-        self._kv_waiters: Dict[str, List[asyncio.Future]] = {}
+        # cluster KV: a lock-partitioned thread-safe store shared with the
+        # GCS shard servers (gcs/shards.py) — the head's internal reads and
+        # writes and the shard listeners operate on the SAME table, so
+        # sharding is purely a question of which event loop serves an RPC
+        from ray_tpu.gcs.shards import ActorMirror, GcsShardServer, ObjectMirror, ShardedKV
+
+        self.kv = ShardedKV(max(1, RayConfig.gcs_kv_shards or 1))
+        # read replicas of the object seal-state + actor directory, written
+        # through on every head-side transition and served by the shards
+        self._obj_mirror = ObjectMirror()
+        self._actor_mirror = ActorMirror()
+        self._shard_server: Optional[GcsShardServer] = None
+        self.shard_addrs: List[str] = []
         # pubsub: channel -> {conn_id: Connection}
         self.subscribers: Dict[str, Dict[int, Connection]] = {}
 
         self.task_queue: List[TaskEntry] = []
         self.tasks: Dict[bytes, TaskEntry] = {}  # leased/running by task id
         self.finished_task_count = 0
+        # worker-lease fast path: lease_id -> worker_id, plus the holder
+        # index (head-granted leases die with their driver connection)
+        self.leases: Dict[bytes, bytes] = {}
+        self._leases_by_conn: Dict[int, Set[bytes]] = {}
         # rolling task-execution event log for `ray-tpu timeline` (analog:
         # reference core_worker/profiling.cc → GCS → chrome trace)
         from collections import deque
@@ -457,6 +514,30 @@ class HeadServer:
             advertise = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
         node.transfer_addr = f"{advertise}:{transfer_port}"
 
+        # GCS shards: per-shard event loops + listeners for the KV /
+        # object-locate / actor-directory read planes, so those RPCs stop
+        # serializing behind task dispatch on this loop.  Shard-side table
+        # mutations marshal their WAL records back here (the WAL fd is
+        # owned by the head loop's persist machinery).
+        nshards = RayConfig.gcs_kv_shards
+        if nshards > 0:
+            from ray_tpu.gcs.shards import GcsShardServer
+
+            head_loop = asyncio.get_running_loop()
+
+            def _shard_wal(*record):
+                head_loop.call_soon_threadsafe(self._wal, *record)
+
+            self._shard_server = GcsShardServer(
+                self.kv,
+                self._obj_mirror,
+                self._actor_mirror,
+                host=self.host,
+                wal_cb=_shard_wal,
+                dirty_cb=self._mark_tables_dirty,
+            )
+            self.shard_addrs = self._shard_server.start(nshards, advertise=advertise)
+
         # head node's own Prometheus scrape endpoint (raylets run their own)
         from ray_tpu.raylet.metrics_agent import start_metrics_server
 
@@ -532,6 +613,8 @@ class HeadServer:
 
     async def stop(self):
         self._shutdown = True
+        if self._shard_server is not None:
+            self._shard_server.stop()
         if self._storage is not None:
             try:
                 async with self._compact_lock:
@@ -703,6 +786,15 @@ class HeadServer:
             self.actors[spec.actor_id] = actor
             if spec.name:
                 self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
+            self._actor_mirror.upsert(
+                spec.actor_id,
+                state=ACTOR_PENDING,
+                name=spec.name,
+                namespace=spec.namespace,
+                creation_spec=wire,
+                direct_addr="",
+                death_cause="",
+            )
             for oid in spec.return_object_ids():
                 self._object_entry(oid)
             # old worker processes died with the previous head; re-run the
@@ -744,6 +836,7 @@ class HeadServer:
         ):
             e = self._object_entry(oid)
             e[0] = SEALED
+            self._obj_mirror.seal(oid)
         logger.info(
             "restored GCS tables: %d kv, %d detached actors, %d pgs, "
             "%d object locations, %d spilled, %d lineage entries "
@@ -837,6 +930,15 @@ class HeadServer:
                     pass
 
     async def _on_disconnect(self, cid: int):
+        # leases die with the connection that holds them (driver exit, or
+        # a worker whose nested submits cached leases)
+        for lid in self._leases_by_conn.pop(cid, set()):
+            wid = self.leases.get(lid)
+            w = self.workers.get(wid) if wid else None
+            if w is not None and w.lease is not None:
+                self._release_lease(
+                    w, self.nodes.get(w.node_id), reason="holder disconnected"
+                )
         kind = self._conn_kind.pop(cid, None)
         if kind == "worker":
             wid = self._conn_worker.pop(cid, None)
@@ -861,6 +963,10 @@ class HeadServer:
         node.transfer_addr = p.get("transfer_addr", "")
         if p.get("metrics_addr"):
             node.labels["metrics_addr"] = p["metrics_addr"]
+        if p.get("dispatch_addr"):
+            # the node's lease agent: clients dial it for node-affine
+            # leases (raylet-local dispatch)
+            node.labels["dispatch_addr"] = p["dispatch_addr"]
         self.nodes[nid] = node
         self._record_event("INFO", "node", "node registered", node_id=nid.hex())
         self._conn_kind[cid] = "raylet"
@@ -875,13 +981,24 @@ class HeadServer:
         if node is None:
             raise ValueError("unknown node")
         w = WorkerInfo(wid, nid, conn, p["pid"], has_tpu=bool(p.get("has_tpu")))
+        if p.get("direct_addr"):
+            # worker binds wildcard; its node's transfer address carries
+            # the routable host (same derivation as actor direct addrs)
+            host = str(node.transfer_addr or "127.0.0.1:0").rsplit(":", 1)[0]
+            port = str(p["direct_addr"]).rsplit(":", 1)[-1]
+            w.direct_addr = f"{host or '127.0.0.1'}:{port}"
         self.workers[wid] = w
         node.workers[wid] = w
+        node.mark_idle(w)
         node.starting_workers = max(0, node.starting_workers - 1)
         self._conn_kind[cid] = "worker"
         self._conn_worker[cid] = wid
         self._kick_scheduler()
-        return {"ok": True, "store_path": node.store_path}
+        return {
+            "ok": True,
+            "store_path": node.store_path,
+            "shard_addrs": self.shard_addrs,
+        }
 
     async def h_register_driver(self, cid, conn, p):
         self._conn_kind[cid] = "driver"
@@ -894,6 +1011,7 @@ class HeadServer:
             "ok": True,
             "store_path": self.nodes[self.head_node_id].store_path,
             "node_id": self.head_node_id,
+            "shard_addrs": self.shard_addrs,
         }
 
     async def h_heartbeat(self, cid, conn, p):
@@ -993,7 +1111,11 @@ class HeadServer:
         self._record_event("WARNING", "worker", f"worker died: {reason}", worker_id=wid.hex())
         node = self.nodes.get(w.node_id)
         if node:
-            node.workers.pop(wid, None)
+            node.forget_worker(w)
+        # a leased worker's death releases the lease's resource hold (the
+        # holder notices the conn loss itself and re-routes via the head)
+        if w.lease is not None:
+            self._release_lease(w, node, reason="worker died")
         logger.info("worker %s dead: %s", wid.hex()[:8], reason)
         # if the process is actually still alive (e.g. declared dead because
         # its node was removed), cut its head connection so it exits instead
@@ -1147,6 +1269,9 @@ class HeadServer:
             # graceful release the preemption protocol asked for
             actor.creation_cpu_released = False
             self._preempted_parked.setdefault(actor.actor_id, time.time())
+            self._actor_mirror.upsert(
+                actor.actor_id, state=ACTOR_PREEMPTED, direct_addr=""
+            )
             self._record_event(
                 "WARNING",
                 "preempt",
@@ -1194,6 +1319,9 @@ class HeadServer:
         creation task's h_task_done will unpin again (without this,
         restart underflows the arg refcounts and deletes live objects)."""
         actor.state = ACTOR_RESTARTING
+        self._actor_mirror.upsert(
+            actor.actor_id, state=ACTOR_RESTARTING, direct_addr=""
+        )
         actor.creation_cpu_released = False
         spec = actor.creation_spec
         self._pin_args(spec)
@@ -1218,10 +1346,14 @@ class HeadServer:
             self._wal("kv", ckpt_key, None)
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        self._actor_mirror.upsert(
+            actor.actor_id, state=ACTOR_DEAD, death_cause=reason, direct_addr=""
+        )
         logger.info("actor %s dead: %s", actor.actor_id.hex()[:8], reason)
         self._record_event("ERROR", "actor", f"actor dead: {reason}", actor_id=actor.actor_id.hex())
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
+            self._actor_mirror.drop_name(actor.namespace, actor.name)
         # fail queued calls
         for spec in actor.pending_calls:
             self._unpin_args(spec)
@@ -1263,6 +1395,7 @@ class HeadServer:
     async def _seal_object(self, oid: bytes):
         e = self._object_entry(oid)
         e[0] = SEALED
+        self._obj_mirror.seal(oid)  # wake shard-side waiters too
         self._wal("seal", bytes(oid))
         for fut in self.object_waiters.pop(oid, []):
             if not fut.done():
@@ -1275,6 +1408,7 @@ class HeadServer:
             e = self._object_entry(oid)
             e[0] = ERRORED
             e[1] = error
+            self._obj_mirror.error(oid, error)
             for fut in self.object_waiters.pop(oid, []):
                 if not fut.done():
                     fut.set_result(e)
@@ -1709,6 +1843,7 @@ class HeadServer:
     async def h_free_object(self, cid, conn, p):
         for oid in p["object_ids"]:
             self.objects.pop(oid, None)
+            self._obj_mirror.drop(oid)
             self.object_meta.pop(bytes(oid), None)
             self._delete_everywhere(oid)
             self._release_contained(bytes(oid))
@@ -1743,6 +1878,7 @@ class HeadServer:
             self.object_refcounts.pop(oid, None)
             # out of scope everywhere → evictable; delete eagerly
             self.objects.pop(oid, None)
+            self._obj_mirror.drop(oid)
             self.object_meta.pop(oid, None)
             self._delete_everywhere(oid)
             # nobody can ever get() it again → its lineage is dead too
@@ -1804,6 +1940,7 @@ class HeadServer:
                 e = self._object_entry(roid)
                 e[0] = PENDING
                 e[1] = None
+                self._obj_mirror.reset(roid)
         if spec.task_id not in self.tasks:
             # the attempt budget is consumed only by an actual re-execution —
             # concurrent waiters piggyback on the in-flight one for free
@@ -1955,8 +2092,12 @@ class HeadServer:
                 if node and not entry.blocked:
                     self._release_task_resources(node, spec)
                 if w is not None and not w.dedicated:
-                    w.idle = True
-                    w.idle_since = time.time()
+                    wnode = self.nodes.get(w.node_id)
+                    if wnode is not None:
+                        wnode.mark_idle(w)
+                    else:
+                        w.idle = True
+                        w.idle_since = time.time()
             if spec.task_type == ACTOR_CREATION_TASK:
                 # default-CPU actors give the creation CPU back once up
                 # (or dead): running actors hold 0 CPU by default
@@ -1969,6 +2110,7 @@ class HeadServer:
                 actor = self.actors.get(spec.actor_id)
                 if actor:
                     actor.state = ACTOR_ALIVE
+                    self._actor_mirror.upsert(actor.actor_id, state=ACTOR_ALIVE)
                     await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_ALIVE})
                     # flush queued calls in order
                     calls, actor.pending_calls = actor.pending_calls, []
@@ -2036,6 +2178,248 @@ class HeadServer:
                         pass
         return {"ok": True, "cancelled": False}
 
+    # ------------------------------- worker leases (control-plane fast path)
+
+    async def h_lease_request(self, cid, conn, p):
+        """Grant a worker lease for one resource shape S: the holder pushes
+        its whole queue of S-shaped tasks straight to the leased worker's
+        direct-call server, amortizing the head round-trip to ~0 per task
+        (reference analog: raylet worker-lease reuse,
+        node_manager.cc RequestWorkerLease + direct task submission).  The
+        lease holds S on the node for its lifetime — per-task accounting
+        never touches this loop."""
+        if not RayConfig.lease_cache_enabled:
+            return {"granted": False, "reason": "disabled"}
+        res = {
+            str(k): float(v)
+            for k, v in (p.get("resources") or {"CPU": 1.0}).items()
+        }
+        needs_tpu = res.get(RayConfig.tpu_slice_resource_name, 0) > 0
+        affinity = p.get("node_id")
+        if affinity:
+            node = self.nodes.get(bytes(affinity))
+            if node is None or not node.alive or not node.try_acquire(res):
+                return {"granted": False, "reason": "no capacity"}
+        else:
+            nid = self.sched.pick_and_acquire(
+                res, RayConfig.scheduler_spread_threshold, prefer=self.head_node_id
+            )
+            if nid is None:
+                return {"granted": False, "reason": "no capacity"}
+            node = self.nodes.get(nid)
+            if node is None:
+                return {"granted": False, "reason": "no capacity"}
+        worker = node.pop_idle(needs_tpu)
+        if worker is None or not worker.direct_addr:
+            if worker is not None:
+                node.mark_idle(worker)  # registered pre-fast-path: no addr
+            node.release(res)
+            # denials warm the pool: the client's retry shortly after grants
+            self._maybe_spawn_worker(node, 1, needs_tpu)
+            return {"granted": False, "reason": "no idle worker"}
+        lease_id = os.urandom(12)
+        worker.lease = {
+            "lease_id": lease_id,
+            "cid": cid,
+            "resources": res,
+            "priority": int(p.get("priority", 1)),
+            "via": "head",
+            "granted_at": time.time(),
+            "revoking": False,
+        }
+        self.leases[lease_id] = worker.worker_id
+        self._leases_by_conn.setdefault(cid, set()).add(lease_id)
+        return {
+            "granted": True,
+            "lease_id": lease_id,
+            "worker_id": worker.worker_id,
+            "addr": worker.direct_addr,
+            "node_id": node.node_id,
+        }
+
+    async def h_lease_return(self, cid, conn, p):
+        lease_id = bytes(p["lease_id"])
+        wid = self.leases.get(lease_id)
+        w = self.workers.get(wid) if wid else None
+        if w is None or w.lease is None or bytes(w.lease["lease_id"]) != lease_id:
+            self.leases.pop(lease_id, None)
+            return {"ok": False}
+        self._release_lease(w, self.nodes.get(w.node_id), reason="returned")
+        self._kick_scheduler()
+        return {"ok": True}
+
+    def _release_lease(self, w: WorkerInfo, node: Optional[NodeInfo], reason: str = ""):
+        """Idempotent lease teardown: release the shape hold and return
+        the worker to the pool (unless it died — the death path forgot it
+        already)."""
+        lease = w.lease
+        if lease is None:
+            return
+        w.lease = None
+        lid = bytes(lease["lease_id"])
+        self.leases.pop(lid, None)
+        holders = self._leases_by_conn.get(lease.get("cid"))
+        if holders is not None:
+            holders.discard(lid)
+        if node is not None:
+            node.release(lease["resources"])
+            if (
+                w.worker_id in self.workers
+                and not w.dedicated
+                and w.actor_id is None
+            ):
+                node.mark_idle(w)
+
+    def _revoke_lease(self, w: WorkerInfo, band: int, reason: str = ""):
+        """Lease revocation IS preemption at the grant layer: ask the
+        holder to stop pushing and return; a holder that drains within
+        ``lease_revoke_deadline_s`` keeps every pushed task's single
+        execution (no double-execution), a late one gets its leased worker
+        SIGKILLed — the holder then resubmits unreplied tasks on the
+        preemption budget (typed PreemptedError once spent)."""
+        lease = w.lease
+        if lease is None or lease.get("revoking"):
+            return
+        lease["revoking"] = True
+        self._record_preemption(
+            "lease",
+            victim_band=int(lease.get("priority", 1)),
+            requester_band=band,
+            name="lease",
+            victim=bytes(lease["lease_id"]).hex()[:16],
+            reason=reason,
+        )
+        payload = {"lease_id": lease["lease_id"], "band": band}
+        loop = asyncio.get_running_loop()
+        if lease.get("via") == "raylet":
+            node = self.nodes.get(w.node_id)
+            if node is not None and node.conn is not None:
+                loop.create_task(
+                    node.conn.send(
+                        MsgType.PUSH_TASK,
+                        {"directive": "revoke_lease", **payload},
+                    )
+                )
+        else:
+            conn = self._conns.get(lease.get("cid"))
+            if conn is not None:
+                loop.create_task(conn.send(MsgType.LEASE_REVOKE, payload))
+            else:
+                # holder already gone: reclaim directly, nothing to drain
+                self._release_lease(w, self.nodes.get(w.node_id), reason="holder gone")
+                return
+        loop.create_task(self._lease_revoke_deadline(w, lease))
+
+    async def _lease_revoke_deadline(self, w: WorkerInfo, lease: dict):
+        await asyncio.sleep(RayConfig.lease_revoke_deadline_s)
+        if w.lease is lease:
+            # holder didn't drain + return in time: forced preemption —
+            # kill the leased worker; its death releases the hold, and the
+            # holder's conn loss converts unreplied pushes into
+            # budget-accounted preemptions client-side
+            self._record_preemption(
+                "lease_forced",
+                victim_band=int(lease.get("priority", 1)),
+                requester_band=-1,
+                name="lease",
+                victim=bytes(lease["lease_id"]).hex()[:16],
+                reason="revoke deadline passed",
+            )
+            self._kill_worker_process(w, 9)
+
+    async def h_lease_notify(self, cid, conn, p):
+        """Async accounting of raylet-local grants (the whole point: the
+        head LEARNS about placements instead of brokering them).  Between
+        the grant and this frame the node is transiently oversubscribed in
+        the head's view — same contract as blocked-task reacquisition."""
+        op = str(p.get("op", ""))
+        lid = bytes(p.get("lease_id") or b"")
+        if op == "grant":
+            wid = bytes(p.get("worker_id") or b"")
+            w = self.workers.get(wid)
+            nid = self._conn_node.get(cid) or (w.node_id if w else None)
+            node = self.nodes.get(nid) if nid else None
+            res = {
+                str(k): float(v) for k, v in (p.get("resources") or {}).items()
+            }
+            if node is not None:
+                node.acquire(res)
+            if w is not None:
+                if node is not None:
+                    node.mark_busy(w)
+                w.lease = {
+                    "lease_id": lid,
+                    "cid": -1,
+                    "resources": res,
+                    "priority": int(p.get("priority", 1)),
+                    "via": "raylet",
+                    "granted_at": time.time(),
+                    "revoking": False,
+                }
+                self.leases[lid] = wid
+            elif node is not None:
+                # unknown worker (raced registration): release to stay sane
+                node.release(res)
+        elif op == "return":
+            wid = self.leases.get(lid)
+            w = self.workers.get(wid) if wid else None
+            if w is not None and w.lease is not None and bytes(w.lease["lease_id"]) == lid:
+                self._release_lease(w, self.nodes.get(w.node_id), reason="raylet return")
+            else:
+                self.leases.pop(lid, None)
+            self._kick_scheduler()
+        return {"ok": True}
+
+    async def h_task_stats(self, cid, conn, p):
+        """Batched flight records for tasks that never transit the head
+        (lease / raylet grants reply straight to the caller): join them
+        into the same ring + histograms as TASK_DONE records, tagged with
+        granted_by so the queue-wait split is complete."""
+        from ray_tpu._private import task_events
+
+        node_hex = bytes(p.get("node_id") or b"").hex()
+        for rec in p.get("records", []):
+            phases = {
+                str(k): float(v) for k, v in (rec.get("phases") or {}).items()
+            }
+            if not phases:
+                continue
+            phases.setdefault("done", time.time())
+            name = str(rec.get("name") or "task")
+            gby = str(rec.get("granted_by") or "cached_lease")
+            durs = task_events.durations(phases)
+            tid_hex = bytes(rec.get("task_id") or b"").hex()
+            self.task_records.append(
+                {
+                    "task_id": tid_hex,
+                    "name": name,
+                    "node_id": node_hex,
+                    "pid": int(rec.get("pid", 0)),
+                    "error": bool(rec.get("error")),
+                    "trace": {},
+                    "phases": phases,
+                    "durations": durs,
+                    "granted_by": gby,
+                }
+            )
+            for phase, dur in durs.items():
+                self._observe_phase(phase, name, node_hex, dur, granted_by=gby)
+            es = phases.get("exec_start")
+            if es is not None:
+                self.timeline.append(
+                    {
+                        "name": name,
+                        "pid": int(rec.get("pid", 0)),
+                        "ts": es,
+                        "dur": max(0.0, phases.get("exec_end", es) - es),
+                        "error": bool(rec.get("error")),
+                        "trace": {},
+                        "phases": phases,
+                        "task_id": tid_hex,
+                    }
+                )
+        return {}
+
     # ---------------------------------------------------------------- actors
 
     async def h_create_actor(self, cid, conn, p):
@@ -2051,6 +2435,15 @@ class HeadServer:
         self.actors[spec.actor_id] = actor
         if spec.name:
             self.named_actors[(spec.namespace, spec.name)] = spec.actor_id
+        self._actor_mirror.upsert(
+            spec.actor_id,
+            state=ACTOR_PENDING,
+            name=spec.name,
+            namespace=spec.namespace,
+            creation_spec=p["spec"],
+            direct_addr="",
+            death_cause="",
+        )
         if spec.detached:
             self._wal("dactor", bytes(spec.actor_id), spec.to_wire())
             self._mark_tables_dirty()
@@ -2107,6 +2500,7 @@ class HeadServer:
                 host = str(node.transfer_addr).rsplit(":", 1)[0]
             port = str(p["direct_addr"]).rsplit(":", 1)[-1]
             a.direct_addr = f"{host or '127.0.0.1'}:{port}"
+            self._actor_mirror.upsert(a.actor_id, direct_addr=a.direct_addr)
         return {
             "state": a.state,
             "death_cause": a.death_cause,
@@ -2124,6 +2518,7 @@ class HeadServer:
                     "namespace": a.namespace,
                     "class_name": a.creation_spec.function_name,
                     "node_id": a.node_id or b"",
+                    "worker_id": a.worker_id or b"",
                     "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else 0,
                 }
             )
@@ -2276,38 +2671,31 @@ class HeadServer:
     async def h_kv_put(self, cid, conn, p):
         self._mark_tables_dirty()
         key = p["key"]
-        if p.get("overwrite", True) or key not in self.kv:
-            self.kv[key] = p["value"]
+        # shared put path with the shard servers (gcs/shards.py): store +
+        # wake kv waiters wherever they registered (head or shard loops).
+        # No kv:{key} pubsub publish: nothing subscribes to it, and with
+        # clients routing KV_PUT to the shard listeners a head-only
+        # publish would be a silent divergence trap anyway — waiters are
+        # the notification mechanism for kv rendezvous.
+        added = self.kv.put_notify(key, p["value"], p.get("overwrite", True))
+        if added:
             self._wal("kv", key, p["value"])
-            for fut in self._kv_waiters.pop(key, []):
-                if not fut.done():
-                    fut.set_result(True)
-            await self._publish(f"kv:{key}", {"key": key, "value": p["value"]})
-            return {"added": True}
-        return {"added": False}
+        return {"added": added}
 
     async def h_kv_get(self, cid, conn, p):
         key = p["key"]
         if p.get("wait") and key not in self.kv:
-            # waiter future set by h_kv_put — not a poll loop: N rendezvousing
-            # workers cost zero wakeups until the key lands (r2 weak #8)
+            # waiter future fired by put_notify — not a poll loop: N
+            # rendezvousing workers cost zero wakeups until the key lands
             timeout = p.get("timeout") or RayConfig.collective_rendezvous_timeout_s
-            fut = asyncio.get_running_loop().create_future()
-            waiters = self._kv_waiters.setdefault(key, [])
-            waiters.append(fut)
-            try:
-                await asyncio.wait_for(fut, timeout)
-            except asyncio.TimeoutError:
-                return {"found": False}
-            finally:
-                # h_kv_put pops the whole list on fire; on timeout we must
-                # not leak the dead future (or the key entry) forever
-                cur = self._kv_waiters.get(key)
-                if cur is not None:
-                    if fut in cur:
-                        cur.remove(fut)
-                    if not cur:
-                        self._kv_waiters.pop(key, None)
+            fut = self.kv.register_waiter(key)
+            if fut is not None:
+                try:
+                    await asyncio.wait_for(fut, timeout)
+                except asyncio.TimeoutError:
+                    return {"found": False}
+                finally:
+                    self.kv.unregister_waiter(key, fut)
         v = self.kv.get(key)
         return {"found": v is not None, "value": v if v is not None else b""}
 
@@ -2390,6 +2778,8 @@ class HeadServer:
                     "available": n.resources_available,
                     "labels": n.labels,
                     "num_workers": len(n.workers),
+                    "idle_workers": len(n.idle_pool[False]) + len(n.idle_pool[True]),
+                    "starting_workers": n.starting_workers,
                 }
                 for n in self.nodes.values()
             ]
@@ -2408,7 +2798,15 @@ class HeadServer:
             )
         for e in self.tasks.values():
             if e.state != "QUEUED":
-                out.append({"task_id": e.spec.task_id, "state": e.state, "name": e.spec.function_name})
+                out.append(
+                    {
+                        "task_id": e.spec.task_id,
+                        "state": e.state,
+                        "name": e.spec.function_name,
+                        "type": e.spec.task_type,
+                        "worker_id": e.worker_id or b"",
+                    }
+                )
         return {"tasks": out, "finished": self.finished_task_count}
 
     # -------------------------------------------------------- flight recorder
@@ -2427,6 +2825,7 @@ class HeadServer:
         phases["done"] = time.time()
         spec = entry.spec if entry is not None else None
         name = (spec.function_name or spec.method_name) if spec else "task"
+        gby = str(getattr(spec, "granted_by", "head") or "head") if spec else "head"
         node_hex = (entry.node_id.hex() if entry and entry.node_id else "")
         durs = task_events.durations(phases)
         self.task_records.append(
@@ -2439,22 +2838,36 @@ class HeadServer:
                 "trace": (spec.trace_ctx or {}) if spec else {},
                 "phases": phases,
                 "durations": durs,
+                "granted_by": gby,
             }
         )
         for phase, dur in durs.items():
-            self._observe_phase(phase, name or "task", node_hex, dur)
+            self._observe_phase(phase, name or "task", node_hex, dur, granted_by=gby)
         return phases
 
-    def _observe_phase(self, phase: str, name: str, node_hex: str, dur: float):
+    def _observe_phase(
+        self,
+        phase: str,
+        name: str,
+        node_hex: str,
+        dur: float,
+        granted_by: str = "",
+    ):
         """Fold one task-phase duration into the flight-recorder
-        histograms (see _observe_hist for the write-through contract)."""
+        histograms (see _observe_hist for the write-through contract).
+        Task records carry the grant path (head / cached_lease / raylet)
+        as a label so queue-wait splits by dispatch mode; the dag/serve/
+        train planes omit it."""
         from ray_tpu._private import task_events
 
+        tags = {"phase": phase, "name": name, "node": node_hex[:12]}
+        if granted_by:
+            tags["granted_by"] = granted_by
         self._observe_hist(
             task_events.PHASE_METRIC,
             task_events.PHASE_METRIC_HELP,
             task_events.PHASE_HISTOGRAM_BOUNDARIES,
-            {"phase": phase, "name": name, "node": node_hex[:12]},
+            tags,
             dur,
         )
 
@@ -3203,11 +3616,8 @@ class HeadServer:
         for node in self.nodes.values():
             if not node.alive:
                 continue
-            idle = sum(
-                1
-                for w in node.workers.values()
-                if w.idle and w.actor_id is None and not w.dedicated
-            )
+            # O(1) from the idle index (was an O(workers) scan per tick)
+            idle = len(node.idle_pool[False]) + len(node.idle_pool[True])
             limit = RayConfig.worker_startup_concurrency or max(
                 2, int(node.resources_total.get("CPU", 2))
             )
@@ -3290,6 +3700,29 @@ class HeadServer:
         for entry, node in unfulfilled:
             self._release_task_resources(node, entry.spec)
         self.task_queue = remaining
+        # spawn-ahead for queued actor creations: a creation blocked on
+        # the creation CPU will need a fresh dedicated worker the moment a
+        # slot frees — overlap the (slow) process spawn with the current
+        # creations' startup instead of serializing spawn → create →
+        # spawn.  Excess spawns become idle pool workers (reused by the
+        # next creation or reaped on the idle timeout), so this only
+        # pipelines work that is already committed.
+        creation_backlog = sum(
+            1
+            for e in remaining
+            if e.spec.task_type == ACTOR_CREATION_TASK and not self._needs_tpu(e.spec)
+        )
+        if creation_backlog:
+            alive = [n for n in self.nodes.values() if n.alive]
+            per_node = max(1, creation_backlog // max(1, len(alive)))
+            for node in alive:
+                idle_here = len(node.idle_pool[False])
+                want = per_node - idle_here - node.starting_workers
+                if want > 0:
+                    spawn_demand[(node.node_id, False)] = max(
+                        spawn_demand.get((node.node_id, False), 0),
+                        node.starting_workers + want,
+                    )
         for (nid, tpu), demand in spawn_demand.items():
             node = self.nodes.get(nid)
             if node is not None:
@@ -3300,11 +3733,7 @@ class HeadServer:
         return (spec.resources or {}).get(RayConfig.tpu_slice_resource_name, 0) > 0
 
     def _find_idle_worker(self, node: NodeInfo, spec: TaskSpec) -> Optional[WorkerInfo]:
-        needs_tpu = self._needs_tpu(spec)
-        for w in node.workers.values():
-            if w.idle and w.actor_id is None and not w.dedicated and w.has_tpu == needs_tpu:
-                return w
-        return None
+        return node.pop_idle(self._needs_tpu(spec))
 
     def _maybe_spawn_worker(self, node: NodeInfo, demand: int = 1, tpu: bool = False):
         """Spawn workers up to current demand — the startup-token discipline
@@ -3406,7 +3835,7 @@ class HeadServer:
         entry.state = "RUNNING"
         entry.worker_id = worker.worker_id
         entry.node_id = node.node_id
-        worker.idle = False
+        node.mark_busy(worker)
         worker.running_tasks.add(spec.task_id)
         if spec.task_type == ACTOR_CREATION_TASK:
             worker.dedicated = True
@@ -3557,12 +3986,13 @@ class HeadServer:
         # enumerate eligible victims ONCE cluster-wide, then node-filter
         # the (much smaller) candidate lists per node — not one full
         # actors+tasks table walk per node
-        idle_a, running, busy_a = self._victim_candidates(band)
+        leases, idle_a, running, busy_a = self._victim_candidates(band)
         for node in nodes:
             if not node.total_fit(demand):
                 continue
             nid = node.node_id
             cand = (
+                [x for x in leases if x[1].node_id == nid],
                 [x for x in idle_a if x[1].node_id == nid],
                 [x for x in running if x[1].node_id == nid],
                 [x for x in busy_a if x[1].node_id == nid],
@@ -3579,6 +4009,8 @@ class HeadServer:
             for kind, victim in victims:
                 if kind == "task":
                     self._preempt_task_victim(victim, band, reason=why)
+                elif kind == "lease":
+                    self._revoke_lease(victim, band, reason=why)
                 else:
                     self._spawn_actor_preempt(victim, band, reason=why)
             return True
@@ -3601,13 +4033,27 @@ class HeadServer:
 
     def _victim_candidates(
         self, band: int, node_id: Optional[bytes] = None
-    ) -> Tuple[List, List, List]:
+    ) -> Tuple[List, List, List, List]:
         """Preemption-eligible work strictly below `band`, bucketed in
-        the bottom-up eviction order — (idle preemptible actors, running
-        best-effort tasks, busy preemptible actors) — each entry a
-        (victim_band, obj, releasable_resources) tuple, lowest band
-        first.  The ONE eligibility predicate shared by demand-driven
-        victim selection and the SLO policy."""
+        the bottom-up eviction order — (cached worker leases, idle
+        preemptible actors, running best-effort tasks, busy preemptible
+        actors) — each entry a (victim_band, obj, releasable_resources)
+        tuple, lowest band first.  Leases evict first: revocation is
+        drain-and-return, the cheapest reclamation there is.  The ONE
+        eligibility predicate shared by demand-driven victim selection
+        and the SLO policy."""
+        lease_bucket: List[Tuple[int, object, Dict[str, float]]] = []
+        for lid, wid in self.leases.items():
+            w = self.workers.get(wid)
+            if w is None or w.lease is None or w.lease.get("revoking"):
+                continue
+            lband = int(w.lease.get("priority", 1))
+            if lband >= band:
+                continue
+            if node_id is not None and w.node_id != node_id:
+                continue
+            lease_bucket.append((lband, w, dict(w.lease["resources"])))
+        lease_bucket.sort(key=lambda x: x[0])
         idle_actors: List[Tuple[int, object, Dict[str, float]]] = []
         busy_actors: List[Tuple[int, object, Dict[str, float]]] = []
         running: List[Tuple[int, object, Dict[str, float]]] = []
@@ -3644,18 +4090,18 @@ class HeadServer:
             running.append((t.spec.priority, t, self._task_resources(t.spec)))
         for bucket in (idle_actors, running, busy_actors):
             bucket.sort(key=lambda x: x[0])  # lowest band evicted first
-        return idle_actors, running, busy_actors
+        return lease_bucket, idle_actors, running, busy_actors
 
     def _select_victims(
         self,
         node: NodeInfo,
         band: int,
         demand: Dict[str, float],
-        candidates: Optional[Tuple[List, List, List]] = None,
+        candidates: Optional[Tuple[List, List, List, List]] = None,
     ) -> Optional[List[Tuple[str, object]]]:
         """Bottom-up victim set on one node covering `demand`'s deficit,
         or None when even evicting everything eligible wouldn't fit it.
-        `candidates` is the node-filtered _victim_candidates triple when
+        `candidates` is the node-filtered _victim_candidates tuple when
         the caller already enumerated cluster-wide."""
         avail = node.resources_available
         deficit = {
@@ -3665,7 +4111,7 @@ class HeadServer:
         }
         if not deficit:
             return []  # already fits; nothing to evict
-        idle_actors, running, busy_actors = (
+        leases, idle_actors, running, busy_actors = (
             candidates
             if candidates is not None
             else self._victim_candidates(band, node.node_id)
@@ -3687,7 +4133,9 @@ class HeadServer:
                 if covers:
                     chosen.append((kind, victim))
 
-        take(idle_actors, "actor")  # idle leases: nothing in flight
+        take(leases, "lease")  # cached worker leases: drain-and-return
+        if deficit:
+            take(idle_actors, "actor")  # idle leases: nothing in flight
         if deficit:
             take(running, "task")  # kill + requeue
         if deficit:
@@ -3959,10 +4407,13 @@ class HeadServer:
 
     def _policy_preempt(self, band_below: int, reason: str) -> bool:
         """Evict ONE victim below `band_below`, lowest band first,
-        bottom-up across the cluster (idle preemptible actors, running
-        tasks, busy preemptible actors)."""
-        idle_actors, running, busy_actors = self._victim_candidates(band_below)
+        bottom-up across the cluster (cached leases, idle preemptible
+        actors, running tasks, busy preemptible actors)."""
+        leases, idle_actors, running, busy_actors = self._victim_candidates(
+            band_below
+        )
         for cands, kind in (
+            (leases, "lease"),
             (idle_actors, "actor"),
             (running, "task"),
             (busy_actors, "actor"),
@@ -3972,6 +4423,8 @@ class HeadServer:
             victim = cands[0][1]
             if kind == "task":
                 self._preempt_task_victim(victim, band_below, reason=reason)
+            elif kind == "lease":
+                self._revoke_lease(victim, band_below, reason=reason)
             else:
                 self._spawn_actor_preempt(victim, band_below, reason=reason)
             return True
@@ -4300,4 +4753,8 @@ HeadServer._HANDLERS = {
     MsgType.DAG_STEP: HeadServer.h_dag_step,
     MsgType.SERVE_TRACE: HeadServer.h_serve_trace,
     MsgType.TRAIN_STEP: HeadServer.h_train_step,
+    MsgType.LEASE_REQUEST: HeadServer.h_lease_request,
+    MsgType.LEASE_RETURN: HeadServer.h_lease_return,
+    MsgType.LEASE_NOTIFY: HeadServer.h_lease_notify,
+    MsgType.TASK_STATS: HeadServer.h_task_stats,
 }

@@ -25,16 +25,23 @@ from jax import lax
 def pipeline_apply(
     stage_params: Any,  # this stage's layer slice (leading dim L/pp)
     x: jax.Array,  # [B, ...] full batch, replicated across stages
+    stage_t: jax.Array,  # [1] int32 — this stage's index, fed as pp-sharded data
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     *,
     axis_name: str = "pp",
+    pp: int,
     num_microbatches: int = 4,
 ) -> jax.Array:
     """Run x through all pp stages with a GPipe schedule.  Returns the
     final-stage output, broadcast to every stage (so downstream replicated
-    ops — final norm, head — run without a gather)."""
-    pp = lax.psum(1, axis_name)
-    stage = lax.axis_index(axis_name)
+    ops — final norm, head — run without a gather).
+
+    The stage index arrives as DATA (an arange sharded over pp) and the
+    pipe depth is static, instead of lax.axis_index/psum(1): under the
+    partially-manual shard_map that pp×tp composition needs (tp stays
+    auto), axis_index lowers to a PartitionId instruction the SPMD
+    partitioner rejects (UNIMPLEMENTED on current XLA CPU builds)."""
+    stage = stage_t[0]
     B = x.shape[0]
     # microbatch count must divide the (per-data-shard) batch: fall back to
     # the largest divisor of B ≤ requested (exactness is unaffected — GPipe
@@ -195,6 +202,65 @@ def pipeline_train_1f1b(
     return loss, sg, eg
 
 
+def pipeline_apply_stacked(
+    stacked_params: Any,  # leaves [pp, L/pp, ...] — stage dim explicit
+    x: jax.Array,  # [B, ...] full (per-jit-view) batch
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    mesh,
+    axis_name: str = "pp",
+    pp: int,
+    num_microbatches: int = 4,
+    batch_axes: Tuple[str, ...] = (),
+) -> jax.Array:
+    """GPipe in pure AUTO-sharded form: the stage dimension is a real
+    array axis sharded over `axis_name`, the per-tick stage compute is a
+    ``vmap`` over it, and the stage→stage hop is ``jnp.roll`` on that
+    axis (XLA lowers it to a collective-permute).  No shard_map at all —
+    which is the point: the partially-manual form (manual pp, auto tp)
+    trips partitioner bugs on current XLA builds (PartitionId
+    UNIMPLEMENTED / manual-subgroup check crashes), while this
+    formulation leaves tp-sharded in-stage matmuls entirely to the
+    compiler.  Used by make_pipeline whenever the mesh carries a real
+    auto axis (pp×tp composition)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    B = x.shape[0]
+    M = max(d for d in range(1, min(num_microbatches, B) + 1) if B % d == 0)
+    mbs = x.reshape(M, B // M, *x.shape[1:])
+    rest = (None,) * (x.ndim - 1)
+    acts_sharding = NamedSharding(mesh, P(axis_name, batch_axes or None, *rest))
+    A = jax.lax.with_sharding_constraint(
+        jnp.zeros((pp,) + mbs.shape[1:], x.dtype), acts_sharding
+    )
+    outputs0 = jnp.zeros_like(mbs)
+    # stage-0 selector, broadcast over the microbatch dims
+    sel_first = (jnp.arange(pp) == 0).reshape((pp,) + (1,) * x.ndim)
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+
+    def body(carry, t):
+        A, outputs = carry
+        feed = lax.dynamic_index_in_dim(
+            mbs, jnp.clip(t, 0, M - 1), keepdims=False
+        )
+        inp = jnp.where(sel_first, feed[None], A)
+        out = vstage(stacked_params, inp)  # [pp, mb, ...]
+        out = jax.lax.with_sharding_constraint(out, acts_sharding)
+        out_idx = t - (pp - 1)
+        candidate = lax.dynamic_update_index_in_dim(
+            outputs, out[pp - 1], jnp.clip(out_idx, 0, M - 1), axis=0
+        )
+        outputs = jnp.where(out_idx >= 0, candidate, outputs)
+        # hop activations one stage to the right (ring, like ppermute in
+        # the manual form; stage 0 ignores the wrapped value — it feeds)
+        A = jnp.roll(out, 1, axis=0)
+        return (A, outputs), None
+
+    (_, outputs), _ = lax.scan(body, (A, outputs0), jnp.arange(M + pp - 1))
+    return outputs.reshape(B, *x.shape[1:])
+
+
 def make_pipeline(
     mesh,
     stage_fn: Callable,
@@ -220,6 +286,56 @@ def make_pipeline(
     batch_axes = tuple(
         a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1
     )
+    # real auto axes under the pipeline (tp): the partially-manual
+    # shard_map form is broken on current XLA (see pipeline_apply_stacked)
+    # — take the pure-auto formulation instead
+    auto_axes = [
+        a
+        for a in mesh.axis_names
+        if a != axis_name and a not in batch_axes and mesh.shape[a] > 1
+    ]
+    if auto_axes:
+        from jax.sharding import PartitionSpec as P
+
+        def wrapped_auto(stage_params, x):
+            def restack(leaf):
+                shape = leaf.shape
+                if (
+                    leaf.ndim <= layer_axis
+                    or shape[layer_axis] % pp_size != 0
+                ):
+                    raise ValueError(
+                        f"pipeline params must be layer-stacked on axis "
+                        f"{layer_axis} with a multiple of pp={pp_size} "
+                        f"layers; got shape {shape}."
+                    )
+                new_shape = (
+                    shape[:layer_axis]
+                    + (pp_size, shape[layer_axis] // pp_size)
+                    + shape[layer_axis + 1 :]
+                )
+                leaf = leaf.reshape(new_shape)
+                # pin only the stage dim; every other dim (incl. tp-sharded
+                # ones) stays wherever propagation puts it
+                parts = [P.UNCONSTRAINED] * leaf.ndim
+                parts[layer_axis] = axis_name
+                return jax.lax.with_sharding_constraint(
+                    leaf, jax.sharding.NamedSharding(mesh, P(*parts))
+                )
+
+            stacked = jax.tree.map(restack, stage_params)
+            return pipeline_apply_stacked(
+                stacked,
+                x,
+                stage_fn,
+                mesh=mesh,
+                axis_name=axis_name,
+                pp=pp_size,
+                num_microbatches=num_microbatches,
+                batch_axes=batch_axes,
+            )
+
+        return wrapped_auto
 
     def specs_for(tree):
         def leaf_spec(leaf):
@@ -243,18 +359,22 @@ def make_pipeline(
             pipeline_apply,
             stage_fn=stage_fn,
             axis_name=axis_name,
+            pp=pp_size,
             num_microbatches=num_microbatches,
         )
         x_spec = P(batch_axes or None, *([None] * (x.ndim - 1)))
+        # the stage index rides in as pp-sharded data (see pipeline_apply:
+        # axis_index is not available under the partial-manual shard_map)
+        stage_ids = jnp.arange(pp_size, dtype=jnp.int32)
         # manual over pp + the batch axes only: other mesh axes (tp) stay
         # compiler-managed inside the stage, so tp-sharded layer weights
         # keep their XLA-inserted in-stage collectives under pp (pp×tp)
         return shard_map_compat(
             fn,
             mesh,
-            in_specs=(specs_for(stage_params), x_spec),
+            in_specs=(specs_for(stage_params), x_spec, P(axis_name)),
             out_specs=x_spec,
             manual_axes=(axis_name, *batch_axes),
-        )(stage_params, x)
+        )(stage_params, x, stage_ids)
 
     return wrapped

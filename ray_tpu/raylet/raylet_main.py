@@ -45,6 +45,7 @@ class Raylet:
         self._worker_seq = 0
         self.store = None
         self.object_agent = None
+        self.lease_agent = None  # node-local dispatch (lease_agent.py)
 
     async def run(self):
         from ray_tpu.core.shm_store import ShmObjectStore
@@ -107,6 +108,17 @@ class Raylet:
         transfer_port = await self.object_agent.start()
         advertise = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
 
+        # node-local lease dispatch: workers announce themselves here and
+        # node-affine leases grant without a head round-trip (the head
+        # learns asynchronously via LEASE_NOTIFY)
+        dispatch_addr = ""
+        if RayConfig.raylet_local_dispatch and RayConfig.lease_cache_enabled:
+            from ray_tpu.raylet.lease_agent import LeaseAgent
+
+            self.lease_agent = LeaseAgent(self, advertise)
+            dispatch_port = await self.lease_agent.start()
+            dispatch_addr = f"{advertise}:{dispatch_port}"
+
         # per-node Prometheus scrape endpoint (reference analog:
         # dashboard reporter_agent.py)
         from ray_tpu.raylet.metrics_agent import start_metrics_server
@@ -154,6 +166,7 @@ class Raylet:
                 "address": advertise,
                 "transfer_addr": f"{advertise}:{transfer_port}",
                 "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
+                "dispatch_addr": dispatch_addr,
             },
         )
         if not reply.get("ok"):
@@ -258,6 +271,17 @@ class Raylet:
                     )
                 elif (
                     msg_type == MsgType.PUSH_TASK
+                    and payload.get("directive") == "revoke_lease"
+                ):
+                    # head preemption of a locally-granted lease: forward
+                    # to the holder, which drains + returns through us
+                    if self.lease_agent is not None:
+                        self.lease_agent.revoke(
+                            bytes(payload.get("lease_id") or b""),
+                            int(payload.get("band", 0)),
+                        )
+                elif (
+                    msg_type == MsgType.PUSH_TASK
                     and payload.get("directive") == "kill_worker"
                 ):
                     # preemption victim on this node: the head's os.kill
@@ -337,6 +361,12 @@ class Raylet:
         env["RAY_TPU_STORE_PATH"] = self.store_path
         # per-process chaos stream id (see chaos.py stream_seed)
         env["RAY_TPU_CHAOS_NONCE"] = str(self._worker_seq)
+        if self.lease_agent is not None and self.lease_agent.port:
+            # workers dial the node's lease agent so node-affine leases
+            # grant locally (127.0.0.1: same host by construction)
+            env["RAY_TPU_RAYLET_DISPATCH"] = f"127.0.0.1:{self.lease_agent.port}"
+        else:
+            env.pop("RAY_TPU_RAYLET_DISPATCH", None)
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
             env.pop("JAX_PLATFORMS", None)
@@ -389,6 +419,11 @@ class Raylet:
 
     def shutdown(self):
         self.kill_workers()
+        try:
+            if self.lease_agent is not None:
+                self.lease_agent.stop()
+        except Exception:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
         try:
             if self.object_agent is not None:
                 self.object_agent.stop()
