@@ -74,6 +74,11 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "priority_fair_quantum_s": (float, 0.1, "deficit drained from a job's fair-share counter per dispatch (within-band weighted round-robin over queue-wait)"),
     "slo_preempt_sustain_ticks": (int, 2, "consecutive breaching observer ticks before an SLO with preempt_below_band triggers a policy preemption"),
     "slo_preempt_cooldown_s": (float, 5.0, "minimum spacing between SLO-policy preemptions"),
+    # -- sampling profiler (_private/profiler.py; RAY_TPU_PROFILER env
+    #    gates the plane itself — see the module docstring) --
+    "profiler_hz": (int, 67, "wall-clock sampling rate while armed (67 is co-prime with common 10/50/100 Hz periodic work, so the sampler can't alias against it)"),
+    "profiler_flush_period_s": (float, 1.0, "how often an armed process ships its folded-stack delta to the head (one batched PROFILE_STATS frame per window, never per sample)"),
+    "profiler_max_stacks": (int, 2000, "distinct folded stacks the head keeps per (role, node); overflow folds the smallest counts into a <other> bucket so sample totals stay exact"),
     # -- fault injection (deterministic chaos; see _private/CHAOS.md) --
     "chaos_enable": (bool, False, "make this process chaos-aware: subscribe to runtime arm/disarm pushes"),
     "chaos_seed": (int, 0, "deterministic fault-injection seed (same seed + plan => same per-stream fault sequence)"),

@@ -170,6 +170,16 @@ class MsgType(enum.IntEnum):
     # queue-wait histograms split by granted_by stay complete
     LEASE_NOTIFY = 110  # raylet → head: async accounting of local grants
 
+    # cluster-wide sampling profiler (_private/profiler.py,
+    # util/profile_api.py — same arm/disarm + KV/pubsub fan-out shape as
+    # CHAOS_CTRL): PROFILE_CTRL is the driver→head control RPC
+    # (arm/disarm/status/collect/stacks); armed processes ship folded-
+    # stack deltas and one-shot stack dumps to the head on
+    # fire-and-forget batched PROFILE_STATS frames (one per flush
+    # window, never per sample)
+    PROFILE_CTRL = 111
+    PROFILE_STATS = 112
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

@@ -332,6 +332,17 @@ class CoreWorker:
         if chaos.aware():
             chaos.set_emitter(self._chaos_emit)
             self._chaos_sync()
+        # sampling profiler (_private/profiler.py): one env read; unless
+        # RAY_TPU_PROFILER=0 excised the plane, join the runtime arm/
+        # disarm channel and point the stats sink at the head conn.
+        # Zygote-forked workers land here after the fork, so the env read
+        # sees the fork request's environment, not the zygote parent's.
+        from ray_tpu._private import profiler
+
+        profiler.maybe_init_from_env("worker" if mode == "worker" else "driver")
+        if profiler.aware():
+            profiler.set_emitter(self._profile_emit)
+            self._profile_sync()
 
     # ------------------------------------------------------------- plumbing
 
@@ -503,6 +514,54 @@ class CoreWorker:
                 "process",
                 exc_info=True,
             )
+
+    def _profile_sync(self):
+        """Late-joiner profiler sync + live arm/disarm subscription: a
+        process spawned after a runtime arm picks the control record up
+        from KV ``profile:ctrl``; later arms/disarms arrive over the
+        ``profile`` pubsub channel.  The callback registers synchronously
+        (one dict append); the SUBSCRIBE + late-join KV read ride the io
+        loop fire-and-forget, so a plane that defaults to disarmed adds
+        ZERO blocking round trips to worker startup — the 600-actor
+        creation path must not pay serialized head RPCs for this."""
+        import json as _json
+
+        from ray_tpu._private import profiler
+
+        self._subscriptions.setdefault("profile", []).append(profiler.apply_ctrl)
+
+        async def _sync():
+            try:
+                # subscribe BEFORE the KV read: an arm landing in the gap
+                # then reaches us twice (push + KV), and arm() is
+                # idempotent — the reverse order could miss it entirely
+                await self.conn.send(MsgType.SUBSCRIBE, {"channel": "profile"})
+                reply = await self.conn.request(
+                    MsgType.KV_GET, {"key": "profile:ctrl"}, 10
+                )
+                if reply.get("found"):
+                    profiler.apply_ctrl(
+                        _json.loads(bytes(reply["value"]).decode())
+                    )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "profiler control-channel sync failed; an env-armed "
+                    "sampler (if any) stays active, runtime arm/disarm "
+                    "won't reach this process",
+                    exc_info=True,
+                )
+
+        self.io.spawn(_sync())
+
+    def _profile_emit(self, payload: dict):
+        """Fire-and-forget folded-stack delta frame to the head (called
+        from the sampler thread — must never block)."""
+        if self.node_id:
+            payload = dict(payload, node_id=self.node_id)
+        try:
+            self.io.spawn(self.conn.send(MsgType.PROFILE_STATS, payload))
+        except Exception:  # graftlint: disable=silent-except -- profiler frames are best-effort observability; the process-local totals remain the witness
+            pass
 
     def _chaos_emit(self, ev: dict):
         """Fire-and-forget structured event for a fired fault (RECORD_EVENT

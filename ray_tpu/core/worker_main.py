@@ -799,14 +799,11 @@ def _is_async_actor(cls) -> bool:
 def main():
     # stack dumps on demand: `kill -USR1 <worker pid>` writes every
     # thread's traceback to the worker log — the first tool for "which
-    # worker is wedged, and where" at fleet scale
-    import faulthandler
-    import signal as _signal
+    # worker is wedged, and where" at fleet scale.  Shared helper: head,
+    # raylet, and dashboard mains register the same dump.
+    from ray_tpu._private.profiler import install_sigusr1
 
-    try:
-        faulthandler.register(_signal.SIGUSR1, all_threads=True)
-    except (AttributeError, ValueError, OSError):
-        pass  # non-main thread / unsupported platform: debugging aid only
+    install_sigusr1()
 
     host, port = os.environ["RAY_TPU_HEAD"].split(":")
     node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])

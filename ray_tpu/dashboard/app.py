@@ -26,6 +26,7 @@ class DashboardServer:
         from aiohttp import web
 
         import ray_tpu
+        from ray_tpu._private import profiler as profiler_mod
         from ray_tpu.experimental.state import (
             list_actors,
             list_nodes,
@@ -33,6 +34,12 @@ class DashboardServer:
             list_tasks,
         )
         from ray_tpu.util import metrics as metrics_mod
+
+        # the dashboard's serving thread profiles under its own role, and
+        # registers the same SIGUSR1 dump as every other long-lived
+        # process (re-registration on the host worker is harmless)
+        profiler_mod.set_thread_role("dashboard")
+        profiler_mod.install_sigusr1()
 
         def _json(data):
             return web.json_response(data)
@@ -85,6 +92,28 @@ class DashboardServer:
             from ray_tpu.experimental.state import slo_status
 
             return _json(slo_status())
+
+        async def api_profile(request):
+            """Sampling-profiler surface: ?op=status (armed state +
+            per-(role,node) sample aggregates) or ?op=collect (the folded
+            stacks themselves).  Arm/disarm stay on `ray-tpu profile` /
+            util.profile_api — the dashboard is read-only."""
+            import asyncio as _aio
+
+            from ray_tpu.experimental.state.api import profile_info
+
+            op = request.query.get("op", "status")
+            if op not in ("status", "collect"):
+                return web.json_response(
+                    {"error": f"unknown op {op!r} (status|collect)"},
+                    status=400,
+                )
+            # the control RPC blocks on a head round trip: keep the http
+            # loop live
+            reply = await _aio.get_running_loop().run_in_executor(
+                None, profile_info, op
+            )
+            return _json(reply)
 
         async def api_events(request):
             from ray_tpu.experimental.state.api import list_cluster_events
@@ -156,6 +185,7 @@ class DashboardServer:
             <a href=/api/timeline>timeline</a>
             <a href=/api/task_summary>task_summary</a>
             <a href=/api/slo>slo</a>
+            <a href=/api/profile>profile</a>
             <a href=/api/events>events</a>
             <a href=/api/objects>objects</a></p>
             </body></html>"""
@@ -172,6 +202,7 @@ class DashboardServer:
         app.router.add_get("/api/timeline", api_timeline)
         app.router.add_get("/api/task_summary", api_task_summary)
         app.router.add_get("/api/slo", api_slo)
+        app.router.add_get("/api/profile", api_profile)
         app.router.add_get("/api/events", api_events)
         app.router.add_get("/api/objects", api_objects)
         app.router.add_get("/api/serve/applications", api_serve_get)

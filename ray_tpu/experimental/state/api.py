@@ -98,6 +98,16 @@ def slo_status() -> Dict:
     return summarize_workloads("slo")
 
 
+def profile_info(op: str = "status") -> Dict:
+    """Sampling-profiler state from the head: ``status`` (armed flag +
+    per-(role, node) sample aggregates) or ``collect`` (the folded
+    stacks).  Backend of the dashboard's ``/api/profile``; arm/disarm
+    live in :mod:`ray_tpu.util.profile_api`."""
+    if op not in ("status", "collect"):
+        raise ValueError(f"unknown profile op {op!r} (status|collect)")
+    return _cw().request(MsgType.PROFILE_CTRL, {"op": op})
+
+
 def list_cluster_events(limit: int = 1000) -> List[dict]:
     """Structured lifecycle events: node/actor/worker transitions, OOM
     kills, spill passes (reference analog: src/ray/util/event.h + the

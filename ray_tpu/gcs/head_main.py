@@ -43,6 +43,11 @@ async def _amain(args):
 
 
 def main():
+    # on-demand stack dumps, same registration as every worker:
+    # `kill -USR1 <head pid>` writes all thread tracebacks to head.log
+    from ray_tpu._private.profiler import install_sigusr1
+
+    install_sigusr1()
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
@@ -59,18 +64,40 @@ def main():
     )
     profile_path = os.environ.get("RAY_TPU_HEAD_PROFILE", "")
     if profile_path:
-        # dev/perf diagnosis: profile the head's event loop, dump on exit
-        import cProfile
+        # DEPRECATED alias for the old cProfile hack: now routes through
+        # the cluster sampling profiler (_private/profiler.py) — arms
+        # head-role sampling at startup and writes the head's folded
+        # stacks (flamegraph collapsed format, not pstats) to the path on
+        # exit.  Prefer `ray-tpu profile` / RAY_TPU_PROFILER=1.
+        print(
+            "RAY_TPU_HEAD_PROFILE is deprecated: arming the sampling "
+            "profiler for the head role; output is collapsed-stack text "
+            f"at {profile_path} (use `ray-tpu profile` instead)",
+            file=sys.stderr,
+            flush=True,
+        )
+        # arm THIS process directly — never via os.environ, which every
+        # head-spawned worker inherits (dict(os.environ) in the spawn
+        # path): the alias promises head-role profiling, not a silently
+        # armed sampler in every worker on the node.  An explicit
+        # RAY_TPU_PROFILER=0 (plane excised) still wins inside arm().
+        from ray_tpu._private import profiler
 
-        pr = cProfile.Profile()
-        pr.enable()
-        try:
-            asyncio.run(_amain(args))
-        finally:
-            pr.disable()
-            pr.dump_stats(profile_path)
-    else:
+        profiler.maybe_init_from_env("head")
+        profiler.arm()
+    try:
         asyncio.run(_amain(args))
+    finally:
+        if profile_path:
+            from ray_tpu._private import profiler
+
+            # lifetime view: a mid-run cluster-wide disarm (any
+            # `ray-tpu profile snapshot`) retires the sampler but must
+            # not empty the exit dump the operator asked for
+            stacks = profiler.local_totals(lifetime=True)
+            if stacks:
+                with open(profile_path, "w") as f:
+                    f.write(profiler.folded_text(stacks))
 
 
 if __name__ == "__main__":

@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos
+from ray_tpu._private import profiler as _profiler
 from ray_tpu._private import task_events as _task_events
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -447,6 +448,16 @@ class HeadServer:
         self._slo_breach_ticks: Dict[str, int] = {}
         self._last_policy_preempt = 0.0
         self._preempt_scans_left = 0  # per-tick victim-scan budget
+        # cluster-wide sampling profiler (_private/profiler.py): folded
+        # stacks aggregated per (role, node) from batched PROFILE_STATS
+        # frames, flush-window slices for the chrome timeline, one-shot
+        # native stack dumps (`ray-tpu stacks`), and the active control
+        # record (mirrors kv "profile:ctrl" for status without a parse)
+        self.profile_stacks: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self.profile_meta: Dict[Tuple[str, str], dict] = {}
+        self.profile_slices: "deque" = deque(maxlen=2048)
+        self.profile_stack_dumps: List[dict] = []
+        self.profile_ctrl: Optional[dict] = None
 
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
@@ -470,6 +481,25 @@ class HeadServer:
         # event ring directly (this process OWNS the ring)
         chaos.maybe_init_from_env("head")
         chaos.set_emitter(self._chaos_emit)
+        # profiler scope + emitter: the head ingests its own folded-stack
+        # frames directly, marshalled onto this loop — the sampler thread
+        # must never touch the tables the loop owns (RAY_TPU_PROFILER=1
+        # in the env arms head-role sampling from startup; the deprecated
+        # RAY_TPU_HEAD_PROFILE alias in head_main routes here too)
+        _profiler.maybe_init_from_env("head")
+        if _profiler.aware():
+            _head_loop = asyncio.get_running_loop()
+
+            def _profile_emit(payload: dict, _loop=_head_loop):
+                try:
+                    _loop.call_soon_threadsafe(
+                        self._ingest_profile_frame,
+                        dict(payload, node_id=self.head_node_id),
+                    )
+                except RuntimeError:
+                    pass  # loop already closed (shutdown): frame dropped
+
+            _profiler.set_emitter(_profile_emit)
         # head's own node
         res = dict(self._head_resources)
         res.setdefault("CPU", float(os.cpu_count() or 4))
@@ -3336,6 +3366,195 @@ class HeadServer:
             )
         return {"ok": True, "status": chaos.status()}
 
+    # ------------------------------------------------- sampling profiler
+
+    async def h_profile_ctrl(self, cid, conn, p):
+        """Cluster-wide profiler control (util/profile_api.py): arm /
+        disarm fan out exactly like chaos — applied here, stored in KV
+        ``profile:ctrl`` for late joiners, pushed to live processes over
+        the ``profile`` pubsub channel.  ``collect`` returns the folded
+        stacks aggregated per (role, node); ``stacks`` broadcasts a
+        one-shot native stack-dump request whose replies ``collect_stacks``
+        then returns (`ray-tpu stacks`)."""
+        import json as _json
+
+        op = str(p.get("op", ""))
+        if op == "arm":
+            ctrl = {
+                "op": "arm",
+                "hz": int(p.get("hz") or RayConfig.profiler_hz),
+                "roles": p.get("roles") or None,
+                "deep": bool(p.get("deep")),
+            }
+            if p.get("clear", True):
+                self._clear_profile_aggregation()
+            self.profile_ctrl = ctrl
+            _profiler.apply_ctrl(ctrl)
+            self.kv["profile:ctrl"] = _json.dumps(ctrl).encode()
+            self._record_event(
+                "INFO",
+                "profiler",
+                f"profiler armed at {ctrl['hz']}Hz",
+                hz=ctrl["hz"],
+                roles=ctrl["roles"],
+                deep=ctrl["deep"],
+            )
+            await self._publish("profile", ctrl)
+        elif op == "disarm":
+            self.profile_ctrl = None
+            _profiler.apply_ctrl({"op": "disarm"})
+            self.kv.pop("profile:ctrl", None)
+            self._record_event("INFO", "profiler", "profiler disarmed")
+            await self._publish("profile", {"op": "disarm"})
+        elif op == "collect":
+            out = {
+                "stacks": {
+                    f"{role}|{node}": dict(stacks)
+                    for (role, node), stacks in self.profile_stacks.items()
+                },
+                "meta": {
+                    f"{role}|{node}": dict(meta)
+                    for (role, node), meta in self.profile_meta.items()
+                },
+            }
+            if p.get("clear"):
+                self._clear_profile_aggregation()
+            return out
+        elif op == "stacks":
+            # one-shot native stack dump, cluster-wide: clear the last
+            # harvest, dump this process in-band, fan the request out
+            self.profile_stack_dumps = [
+                {
+                    "role": "head",
+                    "pid": os.getpid(),
+                    "node": self.head_node_id.hex()[:12],
+                    "text": _profiler.dump_stacks(),
+                }
+            ]
+            await self._publish("profile", {"op": "stacks"})
+        elif op == "collect_stacks":
+            return {"dumps": list(self.profile_stack_dumps)}
+        elif op != "status":
+            raise ValueError(f"unknown profile op {op!r}")
+        agg = {
+            f"{role}|{node}": {
+                "samples": sum(stacks.values()),
+                "distinct_stacks": len(stacks),
+                **{
+                    k: v
+                    for k, v in self.profile_meta.get((role, node), {}).items()
+                    if k in ("overhead_ratio", "idle", "hz")
+                },
+            }
+            for (role, node), stacks in self.profile_stacks.items()
+        }
+        return {
+            "ok": True,
+            "armed": self.profile_ctrl is not None,
+            "ctrl": dict(self.profile_ctrl) if self.profile_ctrl else None,
+            "aggregate": agg,
+            "local": _profiler.status(),
+        }
+
+    def _clear_profile_aggregation(self):
+        self.profile_stacks.clear()
+        self.profile_meta.clear()
+        self.profile_slices.clear()
+
+    async def h_profile_stats(self, cid, conn, p):
+        """Fire-and-forget folded-stack delta (or stack-dump) frame from
+        an armed process — one per flush window, never per sample."""
+        self._ingest_profile_frame(p)
+        return {}
+
+    def _ingest_profile_frame(self, p: dict):
+        node_raw = p.get("node_id")
+        node = bytes(node_raw).hex()[:12] if node_raw else "local"
+        role_proc = str(p.get("role", "?"))
+        pid = int(p.get("pid") or 0)
+        if "stack_dump" in p:
+            if len(self.profile_stack_dumps) < 256:
+                self.profile_stack_dumps.append(
+                    {
+                        "role": role_proc,
+                        "pid": pid,
+                        "node": node,
+                        "text": str(p["stack_dump"]),
+                    }
+                )
+            return
+        stacks = p.get("stacks") or {}
+        per_role: Dict[str, int] = {}
+        for folded, n in stacks.items():
+            folded = str(folded)
+            # the stack's own root segment is its effective role: engine /
+            # dashboard threads aggregate under their thread-role even
+            # though the shipping process is a worker
+            role = folded.split(";", 1)[0]
+            n = int(n)
+            per_role[role] = per_role.get(role, 0) + n
+            bucket = self.profile_stacks.setdefault((role, node), {})
+            bucket[folded] = bucket.get(folded, 0) + n
+            if len(bucket) > RayConfig.profiler_max_stacks:
+                self._trim_profile_bucket(role, bucket)
+        for role, n in per_role.items():
+            self._inc_counter(
+                "ray_tpu_profiler_samples_total",
+                "Wall-clock profiler stack samples aggregated at the head",
+                {"role": role, "node": node},
+                float(n),
+            )
+        wall = float(p.get("wall_s") or 0.0)
+        if wall > 0:
+            ratio = float(p.get("overhead_s") or 0.0) / wall
+            self._set_gauge(
+                "ray_tpu_profiler_overhead_ratio",
+                "Fraction of wall time the armed sampler spends sampling "
+                "(the ≤5% contract's numerator)",
+                {"role": role_proc, "node": node},
+                ratio,
+            )
+            # meta lands under every stack-root role this frame carried
+            # (plus the process role): an engine/dashboard bucket's
+            # sampler IS its host process's sampler, so its status row
+            # must show that sampler's overhead/hz, not blanks
+            for meta_role in set(per_role) | {role_proc}:
+                meta = self.profile_meta.setdefault((meta_role, node), {})
+                meta.update(
+                    {
+                        "overhead_ratio": ratio,
+                        "idle": int(p.get("idle") or 0),
+                        "hz": int(p.get("hz") or 0),
+                        "pid": pid,
+                    }
+                )
+        if per_role:
+            top = sorted(stacks.items(), key=lambda kv: -int(kv[1]))[:5]
+            self.profile_slices.append(
+                {
+                    "t0": float(p.get("t0") or time.time()),
+                    "t1": float(p.get("t1") or time.time()),
+                    "role": role_proc,
+                    "node": node,
+                    "pid": pid,
+                    "samples": sum(per_role.values()),
+                    "top": [[k, int(v)] for k, v in top],
+                }
+            )
+
+    @staticmethod
+    def _trim_profile_bucket(role: str, bucket: Dict[str, int]):
+        """Cap a (role, node) bucket at profiler_max_stacks by folding the
+        smallest counts into one <other> stack — sample totals stay exact,
+        only the tail's split degrades."""
+        keep = RayConfig.profiler_max_stacks * 3 // 4
+        ranked = sorted(bucket.items(), key=lambda kv: -kv[1])
+        spill = sum(n for _, n in ranked[keep:])
+        bucket.clear()
+        bucket.update(ranked[:keep])
+        other = f"{role};<other>"
+        bucket[other] = bucket.get(other, 0) + spill
+
     def _record_event(self, severity: str, source: str, message: str, **fields):
         self.events.append(
             {
@@ -3473,6 +3692,28 @@ class HeadServer:
                         k: v
                         for k, v in ev.items()
                         if k not in ("timestamp", "message", "source")
+                    },
+                }
+            )
+        # sampled-stack slices (one per profiler flush window per process)
+        # render as spans on the same view, so a queue-wait span and the
+        # stacks that caused it sit side by side; args carry the window's
+        # top folded stacks for drill-down
+        for s in self.profile_slices:
+            events.append(
+                {
+                    "name": f"profile:{s['role']}",
+                    "cat": "profile",
+                    "ph": "X",
+                    "ts": s["t0"] * 1e6,
+                    "dur": max(0.0, s["t1"] - s["t0"]) * 1e6,
+                    "pid": s["pid"],
+                    "tid": s["pid"],
+                    "args": {
+                        "role": s["role"],
+                        "node": s["node"],
+                        "samples": s["samples"],
+                        "top_stacks": s["top"],
                     },
                 }
             )
@@ -4757,4 +4998,6 @@ HeadServer._HANDLERS = {
     MsgType.LEASE_RETURN: HeadServer.h_lease_return,
     MsgType.LEASE_NOTIFY: HeadServer.h_lease_notify,
     MsgType.TASK_STATS: HeadServer.h_task_stats,
+    MsgType.PROFILE_CTRL: HeadServer.h_profile_ctrl,
+    MsgType.PROFILE_STATS: HeadServer.h_profile_stats,
 }

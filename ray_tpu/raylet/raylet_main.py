@@ -23,6 +23,7 @@ import traceback
 from typing import List
 
 from ray_tpu._private import chaos
+from ray_tpu._private import profiler
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.protocol import Connection, MsgType
@@ -153,6 +154,7 @@ class Raylet:
             metrics_port = 0
 
         chaos.maybe_init_from_env("raylet")
+        profiler.maybe_init_from_env("raylet")
         conn = await Connection.connect(self.head_host, self.head_port)
         self.conn = conn
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
@@ -225,6 +227,36 @@ class Raylet:
                 print(
                     "raylet: chaos control-channel sync failed; env-armed "
                     "plan (if any) stays active",
+                    file=sys.stderr,
+                )
+        if profiler.aware():
+            # folded-stack deltas → the head aggregator; late-join the
+            # active control record; live arm/disarm pushes land in the
+            # PUBLISH branch of _read_loop
+            def _profile_emit(payload: dict):
+                asyncio.run_coroutine_threadsafe(
+                    conn.send(
+                        MsgType.PROFILE_STATS,
+                        dict(payload, node_id=self.node_id.binary()),
+                    ),
+                    loop,
+                )
+
+            profiler.set_emitter(_profile_emit)
+            try:
+                # subscribe BEFORE the KV read: an arm landing in the gap
+                # then reaches us twice (push + KV, arm is idempotent);
+                # the reverse order could miss it entirely
+                await conn.request(MsgType.SUBSCRIBE, {"channel": "profile"}, 10)
+                kv = await conn.request(
+                    MsgType.KV_GET, {"key": "profile:ctrl"}, 10
+                )
+                if kv.get("found"):
+                    profiler.apply_ctrl(json.loads(bytes(kv["value"]).decode()))
+            except Exception:  # noqa: BLE001
+                print(
+                    "raylet: profiler control-channel sync failed; env-armed "
+                    "sampler (if any) stays active",
                     file=sys.stderr,
                 )
         print(f"NODE {self.node_id.hex()}", flush=True)
@@ -312,6 +344,11 @@ class Raylet:
                     and payload.get("channel") == "chaos"
                 ):
                     chaos.apply_ctrl(payload.get("message") or {})
+                elif (
+                    msg_type == MsgType.PUBLISH
+                    and payload.get("channel") == "profile"
+                ):
+                    profiler.apply_ctrl(payload.get("message") or {})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -441,6 +478,8 @@ class Raylet:
 
 
 def main():
+    # same on-demand stack dump every worker registers (kill -USR1)
+    profiler.install_sigusr1()
     parser = argparse.ArgumentParser()
     parser.add_argument("--head", required=True)  # host:port
     parser.add_argument("--resources", default="{}")

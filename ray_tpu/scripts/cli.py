@@ -285,6 +285,95 @@ def cmd_summary(args):
     return 0
 
 
+def _write_folded(stacks, out_path):
+    """Write a profile_api.collect() result as ONE merged collapsed-stack
+    file (role;pid;thread roots keep per-process flames separable) and
+    print the per-bucket totals + each bucket's hottest leaf frames."""
+    from ray_tpu.util import profile_api
+
+    with open(out_path, "w") as f:
+        f.write(profile_api.folded_text(stacks))
+    total = 0
+    for bucket in sorted(stacks):
+        per = stacks[bucket]
+        n = sum(per.values())
+        total += n
+        top = sorted(per.items(), key=lambda kv: -kv[1])[:3]
+        print(f"  {bucket:24s} {n:7d} samples, {len(per)} stacks")
+        for folded, count in top:
+            leaf = folded.rsplit(";", 1)[-1]
+            print(f"      {count:6d}  {leaf}")
+    print(f"wrote {total} samples to {out_path}")
+    print("render with: flamegraph.pl " + out_path + " > profile.svg")
+
+
+def cmd_profile(args):
+    """`ray-tpu profile start|stop|snapshot|status`: the cluster-wide
+    wall-clock sampling profiler (see util/profile_api.py)."""
+    import ray_tpu
+    from ray_tpu.util import profile_api
+
+    ray_tpu.init(address=_read_address(args))
+    roles = args.role or None
+    if args.action == "start":
+        st = profile_api.start(hz=args.hz, roles=roles, deep=args.deep)
+        print(
+            f"profiler armed (hz={st.get('ctrl', {}).get('hz', args.hz)}, "
+            f"roles={roles or 'all'}, deep={args.deep})"
+        )
+        return 0
+    if args.action == "status":
+        st = profile_api.status()
+        print(f"armed: {st.get('armed')}  ctrl: {st.get('ctrl')}")
+        for bucket, agg in sorted((st.get("aggregate") or {}).items()):
+            print(
+                f"  {bucket:24s} samples={agg.get('samples', 0):7d} "
+                f"stacks={agg.get('distinct_stacks', 0):5d} "
+                f"overhead={agg.get('overhead_ratio', 0.0):.2%}"
+            )
+        return 0
+    out = args.out or f"/tmp/ray-tpu-profile-{int(time.time())}.folded"
+    if args.action == "stop":
+        # disarm FIRST: the disarm-triggered final flush carries each
+        # process's last partial window; collecting before it lands
+        # would drop up to profiler_flush_period_s of samples
+        profile_api.stop()
+        time.sleep(1.0)
+        stacks = profile_api.collect()
+    else:  # snapshot
+        stacks = profile_api.snapshot(
+            duration=args.duration, hz=args.hz, roles=roles, deep=args.deep
+        )
+    if not stacks:
+        print(
+            "no samples collected (is the cluster idle, or was every "
+            "process started with RAY_TPU_PROFILER=0?)"
+        )
+        return 1
+    _write_folded(stacks, out)
+    return 0
+
+
+def cmd_stacks(args):
+    """`ray-tpu stacks`: one-shot cluster-wide native stack dump over
+    PROFILE_CTRL — every profiler-aware process ships all-thread
+    tracebacks to the head."""
+    import ray_tpu
+    from ray_tpu.util import profile_api
+
+    ray_tpu.init(address=_read_address(args))
+    dumps = profile_api.stack_dumps()
+    if not dumps:
+        print("no stack dumps arrived (RAY_TPU_PROFILER=0 everywhere?)")
+        return 1
+    for d in dumps:
+        print(f"##### {d.get('role')} pid={d.get('pid')} node={d.get('node')}")
+        print(d.get("text", ""))
+        print()
+    print(f"({len(dumps)} process dumps)")
+    return 0
+
+
 def cmd_slo(args):
     """`ray-tpu slo`: the watchdog's verdict per declared SLO."""
     import ray_tpu
@@ -356,6 +445,34 @@ def main():
     p = sub.add_parser("slo", help="SLO watchdog verdicts (exit 1 on a breach)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "profile",
+        help="cluster-wide sampling profiler (flamegraph collapsed stacks)",
+    )
+    p.add_argument("action", choices=["start", "stop", "snapshot", "status"])
+    p.add_argument("--address", default=None)
+    p.add_argument("--duration", type=float, default=2.0, help="snapshot window (s)")
+    p.add_argument("--hz", type=int, default=None, help="sampling rate (default: profiler_hz config)")
+    p.add_argument(
+        "--role",
+        action="append",
+        default=None,
+        help="only sample these roles (head/raylet/worker/driver/engine/dashboard); repeatable",
+    )
+    p.add_argument(
+        "--deep",
+        action="store_true",
+        help="also collect jax.profiler device traces on RAY_TPU_PROFILER_DEVICE=1 workers",
+    )
+    p.add_argument("--out", "-o", default=None, help="collapsed-stack output file")
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "stacks", help="one-shot cluster-wide native stack dump (all threads)"
+    )
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stacks)
 
     p = sub.add_parser("submit", help="submit a job entrypoint command")
     p.add_argument("--address", default=None)

@@ -203,6 +203,14 @@ class InferenceEngine:
     # ------------------------------------------------------------ the loop
 
     def _run(self) -> None:
+        # the resident loop is its own profiler role: sampled stacks from
+        # this thread aggregate under "engine", not the host worker, so
+        # `ray-tpu profile` separates decode-step time from actor-call
+        # time on the same process (one dict write; no-op when the
+        # profiler plane is hard-off)
+        from ray_tpu._private import profiler
+
+        profiler.set_thread_role("engine")
         try:
             while not self._stop:
                 with self._lock:

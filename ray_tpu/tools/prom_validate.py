@@ -192,6 +192,20 @@ def _scrape(url: str, timeout: float = 30.0) -> str:
         return r.read().decode()
 
 
+def _profiler_samples_nonzero(text: str) -> bool:
+    """The live gate for the profiler plane: at least one
+    ray_tpu_profiler_samples_total series with a positive value (the
+    armed 2s snapshot must have produced aggregated stacks)."""
+    for line in text.splitlines():
+        if line.startswith("ray_tpu_profiler_samples_total") and "{" in line:
+            try:
+                if float(line.rsplit(None, 1)[1]) > 0:
+                    return True
+            except (ValueError, IndexError):
+                continue
+    return False
+
+
 def _live_scrape() -> str:
     """Boot a mini cluster, exercise every metrics plane (tasks, serve
     trace, train probe, memory gauges, an SLO), and return the head
@@ -291,6 +305,13 @@ def _live_scrape() -> str:
             raise RuntimeError("hog survived preemption with a zero budget")
         except PreemptedError:
             pass
+        # profiler plane: arm a 2s snapshot mid-scrape so the
+        # ray_tpu_profiler_samples_total / _overhead_ratio families exist
+        # in the document under validation, with the sample counter gated
+        # nonzero below (the busy work above guarantees non-idle stacks)
+        from ray_tpu.util import profile_api
+
+        profile_api.snapshot(duration=2.0)
         # let the observer loop tick (memory + slo gauges land in kv)
         deadline = time.time() + 20
         addr = None
@@ -304,6 +325,7 @@ def _live_scrape() -> str:
                     and "ray_tpu_shm_used_bytes" in text
                     and "ray_tpu_serve_engine_slots" in text
                     and "ray_tpu_preemptions_total" in text
+                    and _profiler_samples_nonzero(text)
                 ):
                     return text
             time.sleep(1.0)

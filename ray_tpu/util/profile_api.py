@@ -1,0 +1,168 @@
+"""Driver-facing profiler orchestration: arm/disarm the cluster-wide
+sampling profiler, collect folded stacks, take one-shot stack dumps.
+
+The sampler itself lives in :mod:`ray_tpu._private.profiler` (process
+model, overhead contract, folded-stack format — see its docstring); this
+module is the thin client the CLI (``ray-tpu profile`` /
+``ray-tpu stacks``), the dashboard's ``/api/profile``, and tests script
+against — the same layering as :mod:`ray_tpu.util.chaos_api` over
+:mod:`ray_tpu._private.chaos`.
+
+Runtime arm/disarm rides ``MsgType.PROFILE_CTRL`` to the head, which
+arms its own process, stores the control record in KV ``profile:ctrl``
+for late-joining processes, and fans out to every profiler-aware process
+over the ``profile`` pubsub channel.  Armed processes ship folded-stack
+deltas back on batched ``PROFILE_STATS`` frames; the head aggregates per
+(role, node) — what :func:`collect` returns and :func:`snapshot` wraps.
+
+Typical use::
+
+    from ray_tpu.util import profile_api
+    stacks = profile_api.snapshot(duration=2.0)   # {(role|node): {folded: n}}
+    open("cluster.folded", "w").write(profile_api.folded_text(stacks))
+    # flamegraph.pl cluster.folded > cluster.svg
+
+Without a connected driver every call degrades to local-process-only
+(unit-test mode), mirroring chaos_api.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import profiler
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.protocol import MsgType
+
+# how long after a disarm/stacks broadcast we wait for the final
+# fire-and-forget frames to land at the head before collecting
+_SETTLE_S = 0.8
+
+
+def _core_worker():
+    from ray_tpu._private import worker as worker_mod
+
+    if not worker_mod.global_worker.connected:
+        return None
+    return worker_mod.global_worker.core_worker
+
+
+def start(
+    hz: Optional[int] = None,
+    roles: Optional[List[str]] = None,
+    deep: bool = False,
+    clear: bool = True,
+) -> dict:
+    """Arm sampling cluster-wide (and locally).  ``roles`` filters which
+    process/thread roles sample (head, raylet, worker, driver, engine,
+    dashboard); ``deep=True`` additionally requests jax.profiler device
+    traces on opted-in workers; ``clear`` resets the head's aggregation
+    so the collected window starts now.  Returns the head's status."""
+    cw = _core_worker()
+    ctrl = {
+        "op": "arm",
+        "hz": int(hz or RayConfig.profiler_hz),
+        "roles": list(roles) if roles else None,
+        "deep": bool(deep),
+    }
+    profiler.apply_ctrl(ctrl)
+    if cw is None:
+        return profiler.status()
+    return cw.request(MsgType.PROFILE_CTRL, {**ctrl, "clear": bool(clear)})
+
+
+def stop() -> dict:
+    """Disarm cluster-wide (and locally)."""
+    cw = _core_worker()
+    profiler.apply_ctrl({"op": "disarm"})
+    if cw is None:
+        return profiler.status()
+    return cw.request(MsgType.PROFILE_CTRL, {"op": "disarm"})
+
+
+def status() -> dict:
+    """Armed state + per-(role, node) sample aggregates from the head."""
+    cw = _core_worker()
+    if cw is None:
+        return profiler.status()
+    return cw.request(MsgType.PROFILE_CTRL, {"op": "status"})
+
+
+def collect(clear: bool = False) -> Dict[str, Dict[str, int]]:
+    """The folded stacks aggregated at the head, keyed ``role|node`` —
+    each value is a ``{folded_stack: count}`` dict in flamegraph
+    collapsed form (roots are role;pid;thread synthetic frames)."""
+    cw = _core_worker()
+    if cw is None:
+        totals = profiler.local_totals()
+        return {"local": totals} if totals else {}
+    reply = cw.request(MsgType.PROFILE_CTRL, {"op": "collect", "clear": clear})
+    return {k: dict(v) for k, v in (reply.get("stacks") or {}).items()}
+
+
+def snapshot(
+    duration: float = 2.0,
+    hz: Optional[int] = None,
+    roles: Optional[List[str]] = None,
+    deep: bool = False,
+) -> Dict[str, Dict[str, int]]:
+    """Arm → sample for ``duration`` seconds → disarm → collect.  The
+    settle sleep lets every process's final (disarm-triggered) flush
+    frame land before the harvest."""
+    start(hz=hz, roles=roles, deep=deep, clear=True)
+    time.sleep(max(0.0, duration))
+    stop()
+    time.sleep(_SETTLE_S)
+    return collect()
+
+
+def stack_dumps(settle: float = 1.5) -> List[dict]:
+    """One-shot cluster-wide native stack dump (``ray-tpu stacks``):
+    every profiler-aware process captures all-thread tracebacks and ships
+    them to the head.  Returns ``[{role, pid, node, text}, ...]``."""
+    cw = _core_worker()
+    if cw is None:
+        return [
+            {
+                "role": profiler.status().get("role", "?"),
+                "pid": profiler.status().get("pid", 0),
+                "node": "local",
+                "text": profiler.dump_stacks(),
+            }
+        ]
+    cw.request(MsgType.PROFILE_CTRL, {"op": "stacks"})
+    time.sleep(max(0.0, settle))
+    reply = cw.request(MsgType.PROFILE_CTRL, {"op": "collect_stacks"})
+    return list(reply.get("dumps") or [])
+
+
+def folded_text(stacks: Dict[str, Dict[str, int]]) -> str:
+    """Merge a :func:`collect` result into one flamegraph.pl-compatible
+    collapsed-stack document (the role/pid/thread roots keep every
+    process's flame separable inside the single file).  On a multi-node
+    collection the node joins the synthetic roots
+    (``role;node;pid;thread;...``): pids are only unique per host — two
+    containers both numbering from pid 1 must not conflate."""
+    nodes = {k.split("|", 1)[1] if "|" in k else "" for k in stacks}
+    multi_node = len(nodes) > 1
+    merged: Dict[str, int] = {}
+    for bucket, per_bucket in stacks.items():
+        node = bucket.split("|", 1)[1] if "|" in bucket else ""
+        for folded, n in per_bucket.items():
+            if multi_node:
+                role, _, rest = folded.partition(";")
+                folded = f"{role};{node};{rest}"
+            merged[folded] = merged.get(folded, 0) + int(n)
+    return profiler.folded_text(merged)
+
+
+def sample_share(stacks: Dict[str, int], needle: str) -> float:
+    """Fraction of a bucket's samples whose stack contains ``needle``
+    (e.g. a function name) — the "planted hot function dominates"
+    assertion tests and operators both make."""
+    total = sum(stacks.values())
+    if not total:
+        return 0.0
+    hot = sum(n for folded, n in stacks.items() if needle in folded)
+    return hot / total

@@ -57,6 +57,21 @@ def test_dashboard_endpoints(ray_cluster):
     assert summary["total_records"] >= 1
     assert any(row["phase"] == "e2e" for row in summary["summary"])
     assert summary["records"] and "phases" in summary["records"][-1]
+    # sampling-profiler surface: disarmed by default, bad ops rejected
+    prof = json.loads(fetch("/api/profile"))
+    assert prof["armed"] is False and "aggregate" in prof
+    deadline = time.time() + 10
+    while True:
+        try:
+            with urllib.request.urlopen(url + "/api/profile?op=bogus", timeout=10) as r:
+                raise AssertionError(f"bogus op accepted: {r.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
 
 
 def test_multiprocessing_pool(ray_cluster):
