@@ -213,6 +213,57 @@ def _train_loop(config):
     session.report(out)
 
 
+def _dispatch_pair():
+    """Per-step driver-overhead pair (ROADMAP item 2): the SAME tiny LM
+    ``TrainStepSpec`` driven through the eager per-step actor-call path vs
+    the gang-armed resident DAG loop (train/jax/step_dag.py), through the
+    real cluster.  Identical stage functions, identical model/config — the
+    per-step wall-clock gap is the driver dispatch cost the resident DAG
+    deletes.  Runs LAST (the headline fit has released the chip) and pins
+    the pair to CPU: dispatch is a host-path property, and the pair must
+    never re-claim the chip."""
+    import ray_tpu
+    from ray_tpu.models.lm_train import make_lm_step_spec
+    from ray_tpu.train._internal.worker_group import TrainWorker
+    from ray_tpu.train.jax.step_dag import TrainStepDag, _EagerSpecDriver
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    steps = int(os.environ.get("BENCH_DISPATCH_STEPS", "60"))
+    ray_tpu.init(num_cpus=4)
+    try:
+        spec = make_lm_step_spec(
+            "tiny",
+            batch=2,
+            seq=64,
+            steps=1 << 30,  # driven by the timers below, not the spec
+            sync_grads=False,
+            name="bench_dispatch",
+        )
+        tw = ray_tpu.remote(TrainWorker).remote(0, 1)
+        eager = _EagerSpecDriver([tw], spec, None, 0)
+        eager.run(5)  # build + jit warmup off the clock
+        t0 = time.perf_counter()
+        eager.run(steps)
+        eager_ms = (time.perf_counter() - t0) / steps * 1e3
+        eager.finish()
+        dag = TrainStepDag([tw], spec)  # rebuilds state; same seed
+        dag.run(5)
+        t0 = time.perf_counter()
+        dag.run(steps)
+        dag_ms = (time.perf_counter() - t0) / steps * 1e3
+        dag.teardown()
+        return {
+            "eager_step_ms": round(eager_ms, 3),
+            "dag_step_ms": round(dag_ms, 3),
+            "driver_overhead_ms": round(eager_ms - dag_ms, 3),
+            "dispatch_speedup": round(eager_ms / dag_ms, 2),
+            "model": "tiny",
+            "steps": steps,
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
     cfg_d = _bench_config()
     raw = os.environ.get("BENCH_PATH", "train") == "raw"
@@ -307,6 +358,15 @@ def main():
         "step_ms": round(m["step_ms"], 2),
         "loss": round(m["loss"], 4),
     }
+
+    # step-dispatch pair: eager JaxTrainer loop vs the DAG-resident loop
+    # on the same model/config — the tracked driver-overhead line
+    # (scripts/perf_trends.py series bench.train_dispatch_*)
+    if not raw and os.environ.get("BENCH_DISPATCH", "1") != "0":
+        try:
+            result["step_dispatch"] = _dispatch_pair()
+        except Exception as e:  # noqa: BLE001 — the headline number stands alone
+            result["step_dispatch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     if m2 is not None:
         if "error" in m2:

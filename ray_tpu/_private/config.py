@@ -97,6 +97,9 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "dag_ring_slot_min_bytes": (int, 1 << 20, "minimum slot size for a compiled-DAG shm channel ring (sized at 2x the first payload, floored here; bigger payloads overflow inline onto the carrier conn)"),
     "dag_channel_slots": (int, 4, "slots per compiled-DAG shm channel ring (SPSC depth before the writer back-pressures)"),
     "dag_setup_timeout_s": (float, 30.0, "per-participant deadline for DAG_SETUP/DAG_TEARDOWN negotiation (includes waiting out actor creation)"),
+    # -- resident DAG training loop (ray_tpu/train/jax/step_dag.py) --
+    "train_dag_pipeline_depth": (int, 2, "steps the resident train DAG keeps in flight at the driver (batch N+1 enters the input ring while the device runs batch N; bounded additionally by dag_channel_slots)"),
+    "train_dag_step_timeout_s": (float, 300.0, "deadline for one resident train step's metrics to reach the driver before the graph is declared stuck and invalidated"),
 }
 
 

@@ -180,6 +180,14 @@ class MsgType(enum.IntEnum):
     PROFILE_CTRL = 111
     PROFILE_STATS = 112
 
+    # -- compiled-DAG gang setup (ray_tpu/dag/compiled.py) ---------------
+    # Second phase of the two-phase gang compile: DAG_SETUP with
+    # ``arm: false`` installs channels/executors WITHOUT starting the
+    # resident loops, then one DAG_ARM per participant starts every loop
+    # only after ALL participants reported installed — a multi-host mesh
+    # arms atomically or not at all (train/jax/step_dag.py).
+    DAG_ARM = 113
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

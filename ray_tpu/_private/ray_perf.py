@@ -120,6 +120,60 @@ def main():
     print(f"dag compiled vs eager speedup: {compiled_rate / eager_rate:.1f}x")
     compiled.teardown()
 
+    # -- train-step dispatch pair (ROADMAP item 2, train/jax/step_dag.py):
+    # the same trivial TrainStepSpec driven per-step through the eager
+    # actor-call path vs the gang-armed resident DAG loop.  The spec's
+    # compute is ~0, so the per-step rate IS the driver dispatch cost —
+    # the tracked number for "one channel write per step".
+    from ray_tpu.train._internal.worker_group import TrainWorker
+    from ray_tpu.train.jax.step_dag import TrainStepDag, TrainStepSpec
+
+    def _ts_build(config, rank, world):
+        return {"w": 0}
+
+    def _ts_data(state, idx):
+        return idx
+
+    def _ts_step(state, batch):
+        state["w"] += 1
+        return {"w": state["w"]}
+
+    dispatch_spec = TrainStepSpec(
+        build=_ts_build,
+        data=_ts_data,
+        step=_ts_step,
+        steps=1 << 30,  # driven by timeit, not by the spec
+        name="dispatch_pair",
+        block_metrics=False,  # jax-free spec: nothing to block on
+    )
+    tw = ray_tpu.remote(TrainWorker).remote(0, 1)
+    ray_tpu.get(
+        tw.dag_train_build.remote(dispatch_spec, None, 0), timeout=60
+    )
+    eager_i = [0]
+
+    def eager_train_step():
+        ray_tpu.get(tw.dag_tick.remote(eager_i[0]), timeout=60)
+        eager_i[0] += 1
+
+    eager_train_step()  # settle onto the direct-call path before timing
+    eager_ts = timeit("train step dispatch (eager)", eager_train_step, results=results)
+
+    # the resident row drives the production loop shape — pipelined
+    # ``run()`` with ``train_dag_pipeline_depth`` steps in flight (what
+    # fit_spec actually executes) — not a lone synchronous step; per-step
+    # cost is one input-ring write overlapped with the executors.
+    tsd = TrainStepDag([tw], dispatch_spec)
+    dag_ts = timeit(
+        "train step dispatch (dag resident)",
+        lambda: tsd.run(100),
+        multiplier=100,
+        results=results,
+    )
+    results["train dispatch dag vs eager speedup"] = dag_ts / eager_ts
+    print(f"train dispatch dag vs eager speedup: {dag_ts / eager_ts:.1f}x")
+    tsd.teardown()
+
     # -- control-plane rows (worker-lease fast path, gcs/SCHEDULING.md):
     # the same 10k queued-drain shape through the eager head path vs the
     # cached-lease path, plus actor-fleet creation — the tracked numbers

@@ -608,8 +608,11 @@ class WorkerRuntime:
                 # collective.py:151)
                 from ray_tpu.util.collective import init_collective_group
 
-                world_size, rank, backend, group_name = args
-                init_collective_group(world_size, rank, backend, group_name)
+                world_size, rank, backend, group_name, *rest = args
+                init_collective_group(
+                    world_size, rank, backend, group_name,
+                    rendezvous_nonce=rest[0] if rest else "",
+                )
                 return None
             method = getattr(inst, spec.method_name)
             if inspect.iscoroutinefunction(getattr(method, "__func__", method)):
@@ -719,6 +722,22 @@ class WorkerRuntime:
                             await conn.reply(rid, {}, error=f"{type(e).__name__}: {e}")
                         else:
                             await conn.reply(rid, reply)
+                    elif msg_type == MsgType.DAG_ARM:
+                        # gang-setup phase 2: start resident loops installed
+                        # by an unarmed DAG_SETUP (atomic multi-host arming)
+                        if self._dag_runtime is None:
+                            await conn.reply(
+                                rid, {}, error="no dag runtime (setup never ran)"
+                            )
+                        else:
+                            try:
+                                reply = await self._dag_runtime.handle_arm(payload)
+                            except Exception as e:  # noqa: BLE001 -- reported to the compiling driver
+                                await conn.reply(
+                                    rid, {}, error=f"{type(e).__name__}: {e}"
+                                )
+                            else:
+                                await conn.reply(rid, reply)
                     elif msg_type == MsgType.DAG_TEARDOWN:
                         if self._dag_runtime is None:
                             await conn.reply(rid, {"ok": True, "absent": True})

@@ -21,3 +21,13 @@ from ray_tpu.dag.node import (  # noqa: F401
     MultiOutputNode,
 )
 from ray_tpu.exceptions import DagExecutionError, DagInvalidatedError  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: importing the package for declaration must not pull the
+    # driver-side compile machinery (worker connection) in
+    if name in ("CompiledDag", "DagStepFuture"):
+        from ray_tpu.dag import compiled
+
+        return getattr(compiled, name)
+    raise AttributeError(name)

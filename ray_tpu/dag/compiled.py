@@ -26,11 +26,13 @@ Failure contract (dag/DESIGN.md):
 from __future__ import annotations
 
 import asyncio
+import collections
+import concurrent.futures
 import os
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import task_events
 from ray_tpu._private.config import RayConfig
@@ -63,23 +65,67 @@ class _Participant:
         self.min_topo = 1 << 30
 
 
+class DagStepFuture:
+    """One in-flight compiled step, created by ``execute_async``.
+
+    Channels are FIFO, so results resolve strictly in submission order:
+    ``result()`` drains any earlier pending steps first, storing their
+    outcomes into their own futures — out-of-order ``result`` calls are
+    safe, they just do a predecessor's read on its behalf."""
+
+    __slots__ = ("_dag", "seq", "_done", "_exc", "_value")
+
+    def __init__(self, dag: "CompiledDag", seq: int):
+        self._dag = dag
+        self.seq = seq
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._value: Any = None
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def _set_value(self, value: Any) -> None:
+        self._value = value
+        self._done = True
+
+    def _set_exc(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done = True
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block for this step's sink output(s); raises exactly what a
+        synchronous ``execute`` of this step would have raised."""
+        if not self._done:
+            self._dag._collect(self, timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
 class CompiledDag:
     """A compiled static-dataflow graph over existing actors.  Build with
-    ``dag.compile()``; drive with ``execute``; release with ``teardown``."""
+    ``dag.compile()``; drive with ``execute`` (or pipeline steps with
+    ``execute_async``); release with ``teardown``."""
 
-    def __init__(self, output: DAGNode):
+    def __init__(self, output: DAGNode, gang: bool = False):
         from ray_tpu._private import worker as worker_mod
 
         self._cw = worker_mod._require_connected()
-        # _step_lock serializes execute(); _state_lock guards the small
-        # broken/torn-down flags and is NEVER held across blocking channel
-        # IO — the io thread's _mark_broken must always get through to wake
-        # a reader the execute thread is blocked on
+        # _step_lock serializes step submission (seq assignment + input
+        # writes); _read_lock serializes output collection; _state_lock
+        # guards the small broken/torn-down flags and is NEVER held across
+        # blocking channel IO — the io thread's _mark_broken must always
+        # get through to wake a reader a collect thread is blocked on
         self._step_lock = threading.Lock()
+        self._read_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._broken: Optional[str] = None
         self._torn_down = False
         self._seq = 0
+        self._pending: "collections.deque[DagStepFuture]" = collections.deque()
+        self._gang = bool(gang)
         self._dag_id = os.urandom(8).hex()
         self._readers: Dict[str, ChannelReader] = {}
         self._input_writers: List[ChannelWriter] = []
@@ -179,6 +225,7 @@ class CompiledDag:
                 "args": arg_specs,
                 "ins": ins,
                 "outs": [],  # filled below once all consumers are known
+                "lock": bool(n.dag_options.get("lock", True)),
             }
 
         # -- output edges back to the driver
@@ -223,18 +270,42 @@ class CompiledDag:
                 part.conn = self._cw.open_dag_conn(
                     part.direct_addr, on_push=_push, on_close=_lost
                 )
-            for part in sorted(self._participants, key=lambda p: -p.min_topo):
-                reply = self._cw.dag_rpc(
-                    part.conn,
+            if self._gang:
+                # two-phase gang setup: every participant installs its
+                # channels/executors WITHOUT starting a loop (concurrent
+                # DAG_SETUP round, arm=False), then one concurrent DAG_ARM
+                # round starts all resident loops — a multi-host mesh arms
+                # atomically, and any failure unwinds every participant
+                # through the exception path below before a single loop
+                # has run
+                self._gang_round(
                     MsgType.DAG_SETUP,
-                    {"dag_id": self._dag_id, "events": events, "nodes": part.nodes},
-                    RayConfig.dag_setup_timeout_s,
+                    lambda part: {
+                        "dag_id": self._dag_id,
+                        "events": events,
+                        "arm": False,
+                        "nodes": part.nodes,
+                    },
+                    "DAG_SETUP",
                 )
-                if not reply.get("ok"):
-                    raise RuntimeError(
-                        f"DAG_SETUP rejected by {part.actor_id.hex()[:8]}: "
-                        f"{reply.get('error', 'unknown error')}"
+                self._gang_round(
+                    MsgType.DAG_ARM,
+                    lambda part: {"dag_id": self._dag_id},
+                    "DAG_ARM",
+                )
+            else:
+                for part in sorted(self._participants, key=lambda p: -p.min_topo):
+                    reply = self._cw.dag_rpc(
+                        part.conn,
+                        MsgType.DAG_SETUP,
+                        {"dag_id": self._dag_id, "events": events, "nodes": part.nodes},
+                        RayConfig.dag_setup_timeout_s,
                     )
+                    if not reply.get("ok"):
+                        raise RuntimeError(
+                            f"DAG_SETUP rejected by {part.actor_id.hex()[:8]}: "
+                            f"{reply.get('error', 'unknown error')}"
+                        )
             for chan, part, co in input_fanout:
                 self._input_writers.append(
                     ChannelWriter(
@@ -250,6 +321,50 @@ class CompiledDag:
                 self._torn_down = True  # partial wiring: unwind before raising
             self._release(best_effort_remote=True)
             raise
+
+    def _gang_round(
+        self, msg_type, payload_fn: Callable[[_Participant], dict], label: str
+    ) -> None:
+        """One concurrent negotiation round over every participant: all
+        requests in flight at once (gang setup latency is one RTT + the
+        slowest participant, not a sum), all replies collected, any
+        failure aggregated into one error that names the culprits."""
+        timeout = RayConfig.dag_setup_timeout_s
+        futs = []
+        for part in self._participants:
+            futs.append(
+                (
+                    part,
+                    self._cw.io.spawn(
+                        part.conn.request(msg_type, payload_fn(part), timeout)
+                    ),
+                )
+            )
+        errors = []
+        for part, fut in futs:
+            try:
+                reply = fut.result(timeout + 5)
+            except (
+                ConnectionError,
+                OSError,
+                TimeoutError,
+                # distinct from builtin TimeoutError until 3.11
+                concurrent.futures.TimeoutError,
+                asyncio.TimeoutError,
+            ) as e:
+                errors.append(
+                    f"{part.actor_id.hex()[:8]}: {type(e).__name__}: {e}"
+                )
+                continue
+            if not reply.get("ok"):
+                errors.append(
+                    f"{part.actor_id.hex()[:8]}: {reply.get('error', 'rejected')}"
+                )
+        if errors:
+            raise RuntimeError(
+                f"gang {label} failed on {len(errors)} participant(s): "
+                + "; ".join(errors)
+            )
 
     def _resolve_actors(self, by_actor: Dict[bytes, _Participant]) -> None:
         """Wait out actor creation and capture each participant's direct
@@ -292,6 +407,20 @@ class CompiledDag:
         """Run one step: feed ``value`` to the InputNode's consumers, block
         for the sink outputs.  Returns the single sink's value, or a list
         in declaration order for MultiOutputNode graphs."""
+        return self.execute_async(value).result(timeout)
+
+    def execute_async(self, value: Any = None) -> DagStepFuture:
+        """Feed one step's input WITHOUT waiting for its outputs: returns a
+        :class:`DagStepFuture` whose ``result()`` blocks for them.
+
+        This is the pipelining primitive the resident train loop rides
+        (train/jax/step_dag.py): the driver writes step *N+1* into the
+        input channel ring while the executors still run step *N*, so the
+        per-step driver cost really is one channel write.  In-flight depth
+        is naturally bounded by the ring (a full ring back-pressures the
+        writer); results resolve in submission order.  Submission raises
+        ``DagInvalidatedError`` on a broken/torn-down graph exactly like
+        ``execute``."""
         with self._step_lock:
             with self._state_lock:
                 if self._torn_down:
@@ -303,41 +432,96 @@ class CompiledDag:
                     )
                 seq = self._seq
                 self._seq += 1
-            deadline = time.monotonic() + timeout if timeout is not None else None
+                fut = DagStepFuture(self, seq)
+                self._pending.append(fut)
             wire, nbytes = encode_value(value)
             try:
                 for writer in self._input_writers:
                     writer.write(seq, wire, nbytes)
             except ChannelBrokenError as e:
                 self._mark_broken(str(e))
-                raise DagExecutionError(f"input channel failed: {e}") from e
-            outs: List[Any] = []
-            first_err: Optional[BaseException] = None
-            # snapshot: a concurrent teardown swaps self._readers for {}
-            # after posting broken-wakes; the stale readers still deliver
-            # those sentinels, a dict lookup would KeyError instead
-            readers = self._readers
-            for key in self._output_keys:
-                rem = None if deadline is None else max(0.0, deadline - time.monotonic())
-                try:
-                    is_err, out = readers[key].get(timeout=rem)
-                except ChannelBrokenError as e:
-                    self._mark_broken(str(e))
-                    raise DagExecutionError(f"output channel failed: {e}") from e
-                except TimeoutError as e:
-                    # an unread output would desync every later step: a
-                    # timed-out graph is not safely resumable
-                    self._mark_broken(f"execute timed out after {timeout}s")
-                    raise DagExecutionError(str(e)) from e
-                if is_err and first_err is None:
-                    first_err = out
-                outs.append(out)
-            if first_err is not None:
-                # every channel was drained above, so the graph stays valid
-                raise DagExecutionError(
-                    f"a DAG node failed: {first_err}"
-                ) from first_err
-            return outs if self._multi else outs[0]
+                err = DagExecutionError(f"input channel failed: {e}")
+                err.__cause__ = e
+                fut._set_exc(err)
+                raise err
+        return fut
+
+    def _collect(self, fut: DagStepFuture, timeout: Optional[float]) -> None:
+        """Drain pending steps head-first until ``fut`` resolves.  Holds
+        ``_read_lock`` (collection order IS channel order); every outcome
+        lands in its own future, so concurrent ``result()`` callers each
+        get their step's value/error."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._read_lock:
+            while not fut._done:
+                # snapshot: a concurrent teardown swaps self._readers for {}
+                # after posting broken-wakes; the stale readers still deliver
+                # those sentinels, a dict lookup would KeyError instead
+                readers = self._readers
+                with self._state_lock:
+                    if fut._done:
+                        break
+                    if self._torn_down or self._broken is not None:
+                        reason = self._broken or "compiled DAG torn down"
+                        # the step that CAUSED the fault already holds its
+                        # DagExecutionError; every step still in flight
+                        # behind it can only ever be invalid
+                        while self._pending:
+                            head = self._pending.popleft()
+                            if not head._done:
+                                head._set_exc(
+                                    DagInvalidatedError(
+                                        f"compiled DAG invalidated ({reason}); "
+                                        "re-compile on the surviving actors or fail"
+                                    )
+                                )
+                        break
+                    head = self._pending[0] if self._pending else None
+                if head is None:
+                    raise DagInvalidatedError(
+                        "step future does not belong to an in-flight step"
+                    )
+                if head._done:
+                    with self._state_lock:
+                        if self._pending and self._pending[0] is head:
+                            self._pending.popleft()
+                    continue
+                outs: List[Any] = []
+                first_err: Optional[BaseException] = None
+                failure: Optional[DagExecutionError] = None
+                for key in self._output_keys:
+                    rem = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    try:
+                        is_err, out = readers[key].get(timeout=rem)
+                    except ChannelBrokenError as e:
+                        self._mark_broken(str(e))
+                        failure = DagExecutionError(f"output channel failed: {e}")
+                        failure.__cause__ = e
+                        break
+                    except TimeoutError as e:
+                        # an unread output would desync every later step: a
+                        # timed-out graph is not safely resumable
+                        self._mark_broken(f"execute timed out after {timeout}s")
+                        failure = DagExecutionError(str(e))
+                        failure.__cause__ = e
+                        break
+                    if is_err and first_err is None:
+                        first_err = out
+                    outs.append(out)
+                with self._state_lock:
+                    if self._pending and self._pending[0] is head:
+                        self._pending.popleft()
+                if failure is not None:
+                    head._set_exc(failure)
+                    continue  # the loop drains the rest as invalidated
+                if first_err is not None:
+                    # every channel was drained above, so the graph stays
+                    # valid — only this step is poisoned
+                    err = DagExecutionError(f"a DAG node failed: {first_err}")
+                    err.__cause__ = first_err
+                    head._set_exc(err)
+                else:
+                    head._set_value(outs if self._multi else outs[0])
 
     # -------------------------------------------------- io-thread callbacks
 

@@ -52,10 +52,11 @@ CTL_PREFIX = "!ctl:"
 class _NodeState:
     """One installed method node: its channels, consts, and loop thread."""
 
-    def __init__(self, label: str, method, arg_specs: List[dict]):
+    def __init__(self, label: str, method, arg_specs: List[dict], lock: bool = True):
         self.label = label
         self.method = method
         self.arg_specs = arg_specs  # [{"k": kwarg|None, "t": "chan"|"const", ...}]
+        self.lock = lock  # False: run without the actor's sequential lock
         self.readers: List[ChannelReader] = []  # dedup'd, fixed read order
         self.writers: List[ChannelWriter] = []
         self.by_key: Dict[str, ChannelReader] = {}
@@ -124,15 +125,33 @@ class DagWorkerRuntime:
             self._release_dag(dag)
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
         self._dags[dag_id] = dag
+        if payload.get("arm", True):
+            self._arm(dag)
+        return {"ok": True, "nodes": len(dag.nodes)}
+
+    async def handle_arm(self, payload: dict) -> dict:
+        """Gang-setup phase 2: start this participant's resident loops.
+        Sent only after EVERY participant acknowledged its (unarmed)
+        DAG_SETUP, so a multi-host graph arms atomically — no loop runs
+        anywhere until all hosts are wired (step_dag gang contract)."""
+        dag = self._dags.get(str(payload.get("dag_id", "")))
+        if dag is None:
+            return {"ok": False, "error": "dag not installed (setup missing or torn down)"}
+        self._arm(dag)
+        return {"ok": True, "nodes": len(dag.nodes)}
+
+    def _arm(self, dag: _DagInstance) -> None:
+        """Start the resident executor threads (idempotent)."""
         for node in dag.nodes:
+            if node.thread is not None:
+                continue
             node.thread = threading.Thread(
                 target=self._node_loop,
                 args=(dag, node),
-                name=f"dag-exec-{dag_id[:8]}-{node.label}",
+                name=f"dag-exec-{dag.dag_id[:8]}-{node.label}",
                 daemon=True,
             )
             node.thread.start()
-        return {"ok": True, "nodes": len(dag.nodes)}
 
     async def _setup_node(self, dag: _DagInstance, node_p: dict, conn, instance) -> None:
         method_name = str(node_p["method"])
@@ -149,7 +168,12 @@ class DagWorkerRuntime:
                 )
             else:
                 arg_specs.append({"k": spec.get("k"), "t": "chan", "c": str(spec["c"])})
-        node = _NodeState(str(node_p.get("label") or method_name), method, arg_specs)
+        node = _NodeState(
+            str(node_p.get("label") or method_name),
+            method,
+            arg_specs,
+            lock=bool(node_p.get("lock", True)),
+        )
         # register into dag.nodes BEFORE any channel wiring: a failure
         # below (unreachable consumer, dead ring) must let _release_dag
         # close this node's dialed conns and unregister its readers too
@@ -296,6 +320,13 @@ class DagWorkerRuntime:
                     fn(*args, **kwargs), self._runtime.actor.async_loop
                 )
                 return fut.result(), False
+            if not node.lock:
+                # node opted out via bind(...).options(lock=False): it may
+                # overlap the locked nodes and eager calls on this actor —
+                # the declaration site owns the disjoint-state contract
+                # (the resident feeder stage of a train DAG pipelines
+                # against the locked step stage exactly this way)
+                return fn(*args, **kwargs), False
             # compiled steps and eager calls on the same actor are mutually
             # excluded — the actor's sequential-execution contract holds
             # across both modes
@@ -325,8 +356,11 @@ class DagWorkerRuntime:
     # flush a DAG_STEP batch when it reaches this many records or this
     # much staleness — per-step frames would triple the hot loop's process
     # wakeups on a small box (reference analog: task_event_buffer.cc
-    # flushes on a timer, never per event)
-    _EV_BATCH = 16
+    # flushes on a timer, never per event).  64 (was 16): at resident
+    # train-loop rates (~4k steps/s × 3 nodes) a 16-record batch meant an
+    # io-loop wakeup every ~5 steps, which measurably throttled the loop
+    # itself; the staleness bound below keeps low-rate graphs timely.
+    _EV_BATCH = 64
     _EV_FLUSH_S = 0.1
 
     def _emit_step(self, dag, node, seq, is_err, t_wait, t_exec, t_done) -> None:

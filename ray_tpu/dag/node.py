@@ -56,6 +56,25 @@ class ClassMethodNode(DAGNode):
         self._method_name = method_name
         self._bound_args = tuple(args)
         self._bound_kwargs = dict(kwargs)
+        self._dag_options: Dict[str, Any] = {}
+
+    def options(self, *, lock: bool = True) -> "ClassMethodNode":
+        """Per-node execution options, chainable after ``bind``.
+
+        ``lock=False`` runs this node's resident executor WITHOUT the
+        actor's sequential-execution lock, so it can overlap other nodes
+        (and eager calls) on the same actor — the double-buffered feeder
+        stage of a resident train loop needs exactly this.  Contract: an
+        unlocked node must only touch state that is disjoint from (or
+        thread-safe against) everything the locked nodes and eager calls
+        mutate.
+        """
+        self._dag_options["lock"] = bool(lock)
+        return self
+
+    @property
+    def dag_options(self) -> Dict[str, Any]:
+        return self._dag_options
 
     @property
     def method_name(self) -> str:

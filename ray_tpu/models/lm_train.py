@@ -162,3 +162,125 @@ def synthetic_batch(rng: jax.Array, batch: int, seq: int, vocab: int):
     release/air_tests synthetic datasets)."""
     tokens = jax.random.randint(rng, (batch, seq + 1), 0, vocab, dtype=jnp.int32)
     return tokens[:, :-1], tokens[:, 1:]
+
+
+# ---------------------------------------------------------------- step spec
+
+
+def _lm_build(config, rank, world):
+    """Worker-side build for the LM TrainStepSpec: model + jitted grad fn
+    + optimizer, params device-resident from here on.  Same init seed on
+    every rank (the DP contract test_train.py's eager loops use)."""
+    import optax
+
+    cfg = getattr(GPT2Config, config["model"])(compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(int(config["init_seed"])))
+    opt = optax.adam(float(config["lr"]))
+    opt_state = opt.init(params)
+    grad_fn = jax.jit(jax.value_and_grad(lambda p, t, g: model.loss(p, t, g)))
+    return {
+        "cfg": cfg,
+        "params": params,
+        "opt": opt,
+        "opt_state": opt_state,
+        "grad_fn": grad_fn,
+        "rank": rank,
+        "world": world,
+        "batch": int(config["batch"]),
+        "seq": int(config["seq"]),
+        "sync_grads": bool(config["sync_grads"]),
+        "data_seed": int(config["data_seed"]),
+        "group": str(config.get("collective_group", "_train_dp")),
+    }
+
+
+def _lm_data(state, idx):
+    """Deterministic in (rank, step_idx): checkpoint-resume replays the
+    exact stream, which is what makes resumed weights bit-identical."""
+    key = jax.random.PRNGKey(state["data_seed"] + idx * 1000 + state["rank"])
+    return synthetic_batch(
+        key, state["batch"], state["seq"], state["cfg"].vocab_size
+    )
+
+
+def _lm_step(state, batch):
+    import optax
+
+    tokens, targets = batch
+    loss, grads = state["grad_fn"](state["params"], tokens, targets)
+    if state["world"] > 1 and state["sync_grads"]:
+        from ray_tpu.train.jax.train_loop_utils import all_reduce_pytree
+
+        grads = all_reduce_pytree(grads, state["world"], group_name=state["group"])
+    updates, state["opt_state"] = state["opt"].update(grads, state["opt_state"])
+    state["params"] = optax.apply_updates(state["params"], updates)
+    return {"loss": loss}
+
+
+def _lm_fold(state, metrics):
+    return {"loss": float(metrics["loss"])}
+
+
+def _lm_snapshot(state):
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: np.asarray(x),
+        {"params": state["params"], "opt_state": state["opt_state"]},
+    )
+
+
+def _lm_restore(state, snap):
+    state["params"] = jax.tree.map(jnp.asarray, snap["params"])
+    state["opt_state"] = jax.tree.map(jnp.asarray, snap["opt_state"])
+
+
+def make_lm_step_spec(
+    model: str = "tiny",
+    *,
+    batch: int = 4,
+    seq: Optional[int] = None,
+    steps: int = 10,
+    learning_rate: float = 1e-2,
+    checkpoint_every: int = 0,
+    sync_grads: bool = True,
+    init_seed: int = 0,
+    data_seed: int = 1,
+    collective_group: str = "_train_dp",
+    name: str = "lm_train_dag",
+):
+    """A GPT-2 training run as a ``TrainStepSpec`` (train/jax/step_dag.py):
+    the SAME stage functions drive both the eager per-step path and the
+    gang-scheduled resident DAG, so eager-vs-dag weight equality is a
+    property of the system, not the workload.  Used by the bench.py
+    dispatch-overhead pair, the multichip dryrun's gang phase, and
+    tests/test_train_dag.py."""
+    from ray_tpu.train.jax.step_dag import TrainStepSpec
+
+    cfg = getattr(GPT2Config, model)()
+    seq = seq or cfg.block_size
+    return TrainStepSpec(
+        build=_lm_build,
+        data=_lm_data,
+        step=_lm_step,
+        fold=_lm_fold,
+        snapshot=_lm_snapshot,
+        restore=_lm_restore,
+        steps=steps,
+        checkpoint_every=checkpoint_every,
+        config={
+            "model": model,
+            "batch": batch,
+            "seq": seq,
+            "lr": learning_rate,
+            "sync_grads": sync_grads,
+            "init_seed": init_seed,
+            "data_seed": data_seed,
+            # must match JaxConfig.group_name (default TRAIN_GROUP): the
+            # step stage reduces on this group, the backend creates it
+            "collective_group": collective_group,
+        },
+        name=name,
+        flops_per_step=cfg.flops_per_token() * batch * seq,
+    )

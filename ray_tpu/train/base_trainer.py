@@ -57,9 +57,10 @@ class DataParallelTrainer(BaseTrainer):
 
     def __init__(
         self,
-        train_loop_per_worker: Callable,
+        train_loop_per_worker: Optional[Callable] = None,
         *,
         train_loop_config: Optional[Dict[str, Any]] = None,
+        train_step_spec=None,  # TrainStepSpec (train/jax/step_dag.py)
         backend_config: Optional[BackendConfig] = None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
@@ -71,12 +72,25 @@ class DataParallelTrainer(BaseTrainer):
             run_config=run_config,
             resume_from_checkpoint=resume_from_checkpoint,
         )
+        if (train_loop_per_worker is None) == (train_step_spec is None):
+            raise ValueError(
+                "pass exactly one of train_loop_per_worker (the classic "
+                "session loop) or train_step_spec (the per-step spec the "
+                "resident DAG / eager step paths both drive)"
+            )
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
+        self.train_step_spec = train_step_spec
         self.backend_config = backend_config or BackendConfig()
         self.datasets = datasets or {}
 
     def fit(self) -> Result:
+        if self.train_step_spec is not None:
+            # spec-driven training owns its own gang-granular restart loop
+            # (checkpoint-respawn at exact step boundaries — step_dag.py)
+            from ray_tpu.train.jax.step_dag import fit_spec
+
+            return fit_spec(self)
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
         latest_checkpoint: Optional[Checkpoint] = self.resume_from_checkpoint
@@ -129,7 +143,7 @@ class JaxTrainer(DataParallelTrainer):
     """DataParallelTrainer with the Jax backend default
     (the TorchTrainer analog — reference: train/torch/torch_trainer.py:208)."""
 
-    def __init__(self, train_loop_per_worker, **kwargs):
+    def __init__(self, train_loop_per_worker: Optional[Callable] = None, **kwargs):
         from ray_tpu.train.jax.config import JaxConfig
 
         kwargs.setdefault("backend_config", JaxConfig())

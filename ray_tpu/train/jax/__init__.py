@@ -1,4 +1,5 @@
 from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
+from ray_tpu.train.jax.step_dag import TrainStepDag, TrainStepSpec  # noqa: F401
 from ray_tpu.train.jax.step_probe import StepProbe  # noqa: F401
 from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
     all_reduce_gradients,

@@ -25,10 +25,13 @@ from ray_tpu.train.backend import Backend, BackendConfig
 TRAIN_GROUP = "_train_dp"
 
 
-def _join_collective(worker, world_size, rank, backend, group_name):
+def _join_collective(worker, world_size, rank, backend, group_name, nonce=""):
     from ray_tpu.util.collective import init_collective_group
 
-    init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+    init_collective_group(
+        world_size, rank, backend=backend, group_name=group_name,
+        rendezvous_nonce=nonce,
+    )
     return True
 
 
@@ -71,6 +74,13 @@ class JaxConfig(BackendConfig):
     use_jax_distributed: bool = False  # multi-host pod regime
     collective_backend: str = "dcn"  # cross-actor grad reduction transport
     group_name: str = TRAIN_GROUP
+    # Drive a TrainStepSpec through the gang-scheduled resident DAG loop
+    # (train/jax/step_dag.py): per-step driver cost is one channel write,
+    # host input pipelines double-buffer against device compute.  False
+    # keeps the eager per-step actor-call path over the SAME spec
+    # functions (the bit-identical reference).  Ignored for classic
+    # train_loop_per_worker trainers.
+    use_step_dag: bool = False
 
     def backend_cls(self):
         return _JaxBackend
@@ -96,11 +106,19 @@ class _JaxBackend(Backend):
             ]
             ray_tpu.get(refs, timeout=300)
         if n > 1:
+            import os
+
             import ray_tpu
 
+            # per-incarnation rendezvous nonce: a RESPAWNED gang (the
+            # step_dag checkpoint-respawn loop, or the classic restart
+            # path) must never rendezvous against the addr/token KV
+            # entries its dead predecessor left under the same group name
+            nonce = os.urandom(8).hex()
             refs = [
                 w.execute.remote(
-                    _join_collective, n, rank, config.collective_backend, config.group_name
+                    _join_collective, n, rank, config.collective_backend,
+                    config.group_name, nonce,
                 )
                 for rank, w in enumerate(worker_group.workers)
             ]

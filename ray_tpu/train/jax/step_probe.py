@@ -75,8 +75,11 @@ class _NullCtx:
 _NULL = _NullCtx()
 
 # batch TRAIN_STEP frames: per-step sends would put a head wakeup on the
-# step cadence (the exact overhead the probe exists to measure)
-_SHIP_BATCH = 8
+# step cadence (the exact overhead the probe exists to measure).  32
+# (was 8): the resident DAG loop folds thousands of steps/s, and an
+# 8-record batch put an io spawn + stats() pass every 8 steps on the hot
+# loop; the staleness bound keeps slow (real-model) cadences timely.
+_SHIP_BATCH = 32
 _SHIP_FLUSH_S = 0.5
 
 
@@ -227,6 +230,20 @@ class StepProbe:
             batch, self._buf = self._buf, []
             self._last_ship = now
         self._ship(batch)
+
+    def record_step(self, phases: Dict[str, float]) -> None:
+        """Append one PRE-STAMPED step record (canonical ``train_*`` stamp
+        names, ``train_step_start``/``train_step_end`` required).
+
+        The resident DAG train loop (train/jax/step_dag.py) stamps its
+        phases across three pipelined executor threads — feeder, step,
+        fold — so the scoped ``step()``/``phase()`` contexts (which assume
+        one thread walking the phases in order) cannot be used; the fold
+        stage assembles the full dict and hands it over here.  Disabled
+        path: one flag check, nothing allocated."""
+        if not self.enabled:
+            return
+        self._finish(dict(phases))
 
     def flush(self) -> None:
         """Ship buffered records (end of training / tests)."""

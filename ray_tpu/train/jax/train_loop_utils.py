@@ -39,10 +39,20 @@ def all_reduce_gradients(grads, group_name: str = "_train_dp"):
     Uses the dcn ring (cross-process); on a pod-spanning mesh, gradients
     are already psum'd by pjit and this is a no-op.
     """
+    return all_reduce_pytree(
+        grads, session.get_world_size(), group_name=group_name
+    )
+
+
+def all_reduce_pytree(grads, world: int, group_name: str = "_train_dp"):
+    """Session-free mean-allreduce over an explicit world size — the spec
+    functions of the resident train DAG (train/jax/step_dag.py) run
+    outside a TrainSession, so they carry (rank, world) in their state
+    and call this directly; ``all_reduce_gradients`` is the session-bound
+    wrapper."""
     import jax
     import numpy as np
 
-    world = session.get_world_size()
     if world <= 1:
         return grads
     from ray_tpu.util import collective

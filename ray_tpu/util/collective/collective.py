@@ -42,7 +42,7 @@ class _GroupManager:
         self._infos: Dict[str, GroupInfo] = {}
         self._lock = threading.Lock()
 
-    def create(self, backend: str, group_name: str, world_size: int, rank: int, devices=None):
+    def create(self, backend: str, group_name: str, world_size: int, rank: int, devices=None, nonce: str = ""):
         backend = Backend.resolve(backend)
         with self._lock:
             if group_name in self._groups:
@@ -55,7 +55,7 @@ class _GroupManager:
         else:
             from ray_tpu.util.collective.dcn_backend import DcnGroup
 
-            group = DcnGroup(group_name, world_size, rank, _KvShim())
+            group = DcnGroup(group_name, world_size, rank, _KvShim(), nonce=nonce)
             info = GroupInfo(group_name, world_size, rank, backend)
         with self._lock:
             self._groups[group_name] = group
@@ -91,10 +91,14 @@ def init_collective_group(
     backend: str = "dcn",
     group_name: str = "default",
     devices=None,
+    rendezvous_nonce: str = "",
 ):
     """Called by each participant (usually inside a worker actor) to join a
-    group (reference: collective.py:120)."""
-    _manager.create(backend, group_name, world_size, rank, devices)
+    group (reference: collective.py:120).  ``rendezvous_nonce``: one value
+    shared by ALL ranks of one group incarnation — a respawned gang passes
+    a fresh nonce so its dcn rendezvous can never consume a dead
+    predecessor's stale KV entries."""
+    _manager.create(backend, group_name, world_size, rank, devices, nonce=rendezvous_nonce)
 
 
 def create_collective_group(
@@ -103,6 +107,7 @@ def create_collective_group(
     ranks: List[int],
     backend: str = "dcn",
     group_name: str = "default",
+    rendezvous_nonce: str = "",
 ):
     """Driver-side declaration: tells every actor to join (reference:
     collective.py:151 — there it only *declares*; here we actively invoke
@@ -115,7 +120,9 @@ def create_collective_group(
         # ActorHandle.__getattr__ blocks underscore names; build the method
         # explicitly — the worker-side executor special-cases this name
         method = ActorMethod(actor, "_ray_tpu_init_collective")
-        refs.append(method.remote(world_size, rank, backend, group_name))
+        refs.append(
+            method.remote(world_size, rank, backend, group_name, rendezvous_nonce)
+        )
     ray_tpu.get(refs, timeout=180)
 
 

@@ -232,11 +232,18 @@ def _reduce_arrays(a: np.ndarray, b: np.ndarray, op: ReduceOp) -> np.ndarray:
 class DcnGroup:
     """One rank's membership in a TCP ring collective group."""
 
-    def __init__(self, group_name: str, world_size: int, rank: int, kv):
+    def __init__(self, group_name: str, world_size: int, rank: int, kv, nonce: str = ""):
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
         self._kv = kv  # callable interface: kv_put(key, value), kv_get(key, wait, timeout)
+        # rendezvous namespace: a caller-supplied per-incarnation nonce
+        # keeps a respawned gang's rendezvous disjoint from a dead
+        # predecessor's — without it, kv_get(wait=True) happily returns the
+        # STALE addr/token a crashed same-name group left behind and the
+        # fresh ring dials corpses until the accept deadline (the exact
+        # checkpoint-respawn hang train/jax/step_dag.py must never have)
+        self._ns = f"{group_name}:{nonce}" if nonce else group_name
         self._next_sock: Optional[socket.socket] = None
         self._prev_sock: Optional[socket.socket] = None
         self._listener: Optional[socket.socket] = None
@@ -260,10 +267,10 @@ class DcnGroup:
     # ------------------------------------------------------------- topology
 
     def _kv_key(self, rank: int) -> str:
-        return f"collective:{self.group_name}:addr:{rank}"
+        return f"collective:{self._ns}:addr:{rank}"
 
     def _token_key(self, rank: int) -> str:
-        return f"collective:{self.group_name}:token:{rank}"
+        return f"collective:{self._ns}:token:{rank}"
 
     def _build_ring(self):
         """Every rank listens; rank i dials rank (i+1) % n.  Addresses and

@@ -72,6 +72,21 @@ def _parse_bench(path: str, run: str, table, notes: List[str]):
         notes.append(f"{run}: BENCH run not comparable (rc={d.get('rc')}, "
                      f"no parsed metric) — excluded from gated series")
         return
+    # step-dispatch pair (PR 13, train/jax/step_dag.py): driver overhead is
+    # a host-path property measured on a CPU-pinned pair by design, so it
+    # enters its series BEFORE the TPU-platform guard below — the guard
+    # protects FLOP-bound numbers, not dispatch cost.  Gated automatically
+    # once two runs carry it (find_regressions skips 1-point series).
+    sd = parsed.get("step_dispatch") or {}
+    if isinstance(sd.get("dag_step_ms"), (int, float)):
+        _series("bench.train_dispatch_dag_step_ms", sd["dag_step_ms"], run,
+                table, higher_is_better=False, tracked=True)
+    if isinstance(sd.get("eager_step_ms"), (int, float)):
+        _series("bench.train_dispatch_eager_step_ms", sd["eager_step_ms"],
+                run, table, higher_is_better=False)
+    if isinstance(sd.get("dispatch_speedup"), (int, float)):
+        _series("bench.train_dispatch_speedup", sd["dispatch_speedup"], run,
+                table, tracked=True)
     if parsed.get("platform") != "tpu":
         notes.append(f"{run}: BENCH ran on {parsed.get('platform')!r} "
                      "(backend fallback) — excluded from gated series")

@@ -1,0 +1,292 @@
+"""Gang-scheduled resident training steps (ray_tpu/train/jax/step_dag.py).
+
+Covers the PR 13 contract: the eager per-step actor-call path and the
+gang-armed resident DAG loop drive the SAME TrainStepSpec stage functions
+and produce bit-identical trained weights/metrics; the double-buffered
+feeder stage actually overlaps device compute (asserted from the retained
+per-step phase stamps, not wall-clock alone); a participant killed mid-run
+surfaces as typed DagInvalidatedError — never a hang — and a fresh gang
+restored from the last checkpoint resumes at exactly the checkpointed
+step; RAY_TPU_TASK_EVENTS=0 keeps the resident loop stamp-free.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import task_events
+from ray_tpu.exceptions import DagError, DagExecutionError, DagInvalidatedError
+from ray_tpu.train._internal.worker_group import TrainWorker
+from ray_tpu.train.jax.step_dag import (
+    TrainStepDag,
+    TrainStepSpec,
+    _EagerSpecDriver,
+)
+
+pytestmark = pytest.mark.train_dag
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _counter_spec(data_sleep=0.0, step_sleep=0.0, die_at=None, **kw):
+    """Deterministic numpy spec: w accumulates the step index, so after
+    steps 0..N-1 the weight IS N*(N-1)/2 — any skipped or replayed step
+    shows in the value.  ``die_at``: rank-(world-1) calls os._exit at that
+    global step index on a FRESH build only (a restore marks the state
+    resumed), which is the deterministic mid-run participant kill."""
+
+    def build(config, rank, world):
+        return {"w": np.zeros(2), "rank": rank, "world": world, "resumed": False}
+
+    def data(state, idx):
+        if data_sleep:
+            time.sleep(data_sleep)
+        return idx
+
+    def step(state, batch):
+        if (
+            die_at is not None
+            and batch == die_at
+            and state["rank"] == state["world"] - 1
+            and not state["resumed"]
+        ):
+            import os
+
+            os._exit(1)
+        if step_sleep:
+            time.sleep(step_sleep)
+        state["w"] = state["w"] + batch
+        return {"sum": float(state["w"][0])}
+
+    def snapshot(state):
+        return {"w": np.array(state["w"])}
+
+    def restore(state, snap):
+        state["w"] = np.array(snap["w"])
+        state["resumed"] = True
+
+    kw.setdefault("steps", 1 << 30)
+    return TrainStepSpec(
+        build=build,
+        data=data,
+        step=step,
+        snapshot=snapshot,
+        restore=restore,
+        block_metrics=False,
+        **kw,
+    )
+
+
+def _assert_tree_equal(a, b, what="trees"):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"{what}: structure differs"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and np.array_equal(x, y), (
+            f"{what}: leaf {i} differs (max abs diff "
+            f"{np.max(np.abs(x.astype(np.float64) - y.astype(np.float64)))})"
+        )
+
+
+def _lm_fit(use_dag: bool, steps: int = 12, workers: int = 2, **run_kw):
+    from ray_tpu.models.lm_train import make_lm_step_spec
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    from ray_tpu.train.jax.config import JaxConfig
+
+    spec = make_lm_step_spec(
+        "tiny", batch=2, steps=steps, checkpoint_every=0, name="t_train_dag"
+    )
+    trainer = JaxTrainer(
+        train_step_spec=spec,
+        backend_config=JaxConfig(use_step_dag=use_dag),
+        scaling_config=ScalingConfig(num_workers=workers),
+        **run_kw,
+    )
+    return trainer.fit()
+
+
+# ==================================================== eager/dag equivalence
+
+
+def test_eager_vs_dag_bit_identical_weights_and_metrics(ray_start_regular):
+    """The acceptance invariant: the SAME tiny-LM spec driven ≥10 steps
+    through the eager per-step path and the gang-armed resident DAG
+    (2-worker gang, dcn grad allreduce inside the step stage) produces
+    bit-identical trained weights, optimizer state, and per-step metrics.
+    Both paths share every state-mutating function, so a divergence here
+    is a real pipelining bug (reordered step, lost batch, torn state)."""
+    eager = _lm_fit(use_dag=False, steps=12)
+    dag = _lm_fit(use_dag=True, steps=12)
+    assert len(eager.metrics_history) == len(dag.metrics_history) == 12
+    for i, (em, dm) in enumerate(zip(eager.metrics_history, dag.metrics_history)):
+        assert em == dm, f"step {i} metrics diverge: {em} vs {dm}"
+    ce, cd = eager.checkpoint.to_dict(), dag.checkpoint.to_dict()
+    assert ce["step"] == cd["step"] == 12
+    _assert_tree_equal(ce["spec_state"], cd["spec_state"], "trained weights")
+
+
+def test_trainer_rejects_ambiguous_loop_spec(shutdown_only):
+    from ray_tpu.train import JaxTrainer
+
+    with pytest.raises(ValueError, match="exactly one"):
+        JaxTrainer()  # neither loop nor spec
+    with pytest.raises(ValueError, match="exactly one"):
+        JaxTrainer(lambda cfg: None, train_step_spec=_counter_spec(steps=1))
+
+
+# ========================================================== double buffering
+
+
+def test_double_buffer_overlap_engages(ray_start_regular):
+    """The feeder stage (lock=False) must prepare batch N+1 while the
+    locked step stage computes batch N.  Asserted from the retained phase
+    stamps — stamped across the stage threads of ONE process, so the
+    comparison is clock-skew-free — and cross-checked against the eager
+    path, where the same stamps can never overlap."""
+    spec = _counter_spec(data_sleep=0.03, step_sleep=0.03)
+    steps = 10
+
+    w = ray_tpu.remote(TrainWorker).remote(0, 1)
+    dag = TrainStepDag([w], spec)
+    t0 = time.perf_counter()
+    dag.run(steps)
+    dag_dt = time.perf_counter() - t0
+    recs = ray_tpu.get(w.dag_train_records.remote(), timeout=60)
+    dag.teardown()
+    assert len(recs) == steps
+    overlaps = sum(
+        1
+        for prev, nxt in zip(recs, recs[1:])
+        if nxt["train_data_wait_start"] < prev["train_compute_end"]
+    )
+    assert overlaps > 0, (
+        f"double buffer never engaged: no batch N+1 data_wait started "
+        f"before batch N compute ended across {steps} steps"
+    )
+
+    # eager reference: one actor call per step — data_wait N+1 strictly
+    # after compute N, and the serialized wall clock pays data + compute
+    w2 = ray_tpu.remote(TrainWorker).remote(0, 1)
+    eager = _EagerSpecDriver([w2], spec, None, 0)
+    t0 = time.perf_counter()
+    eager.run(steps)
+    eager_dt = time.perf_counter() - t0
+    recs2 = ray_tpu.get(w2.dag_train_records.remote(), timeout=60)
+    eager.finish()
+    assert all(
+        nxt["train_data_wait_start"] >= prev["train_compute_end"]
+        for prev, nxt in zip(recs2, recs2[1:])
+    ), "eager path cannot overlap phases"
+    assert dag_dt < eager_dt, (
+        f"pipelined loop ({dag_dt:.2f}s) not faster than serialized "
+        f"eager feed ({eager_dt:.2f}s) with equal-cost phases"
+    )
+
+
+# ============================================================ failure contract
+
+
+def test_participant_kill_typed_invalidation_then_checkpoint_resume(
+    ray_start_regular,
+):
+    """Kill one gang participant mid-run: the in-flight step surfaces a
+    typed DagError (never a hang), later executes raise DagInvalidatedError,
+    and a FRESH gang restored from the last checkpoint resumes at exactly
+    the checkpointed step — the resumed run's final weights equal an
+    uninterrupted run's bit for bit."""
+    spec = _counter_spec()
+
+    gang = [ray_tpu.remote(TrainWorker).remote(i, 2) for i in range(2)]
+    dag = TrainStepDag(gang, spec)
+    dag.run(4)
+    snap = dag.snapshot()
+    assert snap["step"] == 4
+    ray_tpu.kill(gang[1])
+    with pytest.raises((DagExecutionError, DagInvalidatedError)):
+        # generous pipeline so the write lands before the loss is seen;
+        # the broken transport must wake the read, not time it out
+        dag.run(2)
+    assert dag.invalidated is not None
+    with pytest.raises(DagInvalidatedError):
+        dag.run(1)
+    try:
+        dag.teardown()
+    except DagError:
+        pass  # best-effort on a half-dead gang
+
+    # fresh gang, restored from the checkpoint: next step index is exactly
+    # the checkpointed boundary
+    gang2 = [ray_tpu.remote(TrainWorker).remote(i, 2) for i in range(2)]
+    dag2 = TrainStepDag(gang2, spec, checkpoint=snap)
+    assert dag2.step_index == 4
+    dag2.run(6)
+    final = dag2.snapshot()
+    dag2.teardown()
+    assert final["step"] == 10
+
+    # uninterrupted reference on one more fresh worker pair
+    gang3 = [ray_tpu.remote(TrainWorker).remote(i, 2) for i in range(2)]
+    dag3 = TrainStepDag(gang3, spec)
+    dag3.run(10)
+    ref = dag3.snapshot()
+    dag3.teardown()
+    _assert_tree_equal(final["spec_state"], ref["spec_state"], "resumed weights")
+
+
+def test_fit_spec_respawns_gang_at_exact_step(ray_start_regular):
+    """End-to-end through JaxTrainer: a participant os._exits mid-chunk
+    (after the step-4 checkpoint), fit_spec rebuilds the worker gang and
+    resumes from the checkpoint.  w accumulates the step index, so the
+    final value and every per-step metric pin the resume to EXACTLY step 4
+    — a replayed or skipped step changes the arithmetic."""
+    from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.jax.config import JaxConfig
+
+    steps = 12
+    spec = _counter_spec(die_at=6, steps=steps, checkpoint_every=4)
+    trainer = JaxTrainer(
+        train_step_spec=spec,
+        backend_config=JaxConfig(use_step_dag=True),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert len(result.metrics_history) == steps
+    for i, m in enumerate(result.metrics_history):
+        assert m["sum"] == i * (i + 1) / 2, f"step {i} metric wrong: {m}"
+    ck = result.checkpoint.to_dict()
+    assert ck["step"] == steps
+    assert float(ck["spec_state"]["w"][0]) == steps * (steps - 1) / 2
+
+
+# ========================================================== events contract
+
+
+def test_events_off_keeps_resident_loop_stamp_free(monkeypatch, shutdown_only):
+    """RAY_TPU_TASK_EVENTS=0 contract extended to the resident train loop:
+    the stage functions take the no-stamp branch (no retained records, no
+    probe records), and the head joins zero train records."""
+    monkeypatch.setenv("RAY_TPU_TASK_EVENTS", "0")
+    task_events.set_enabled(False)
+    try:
+        ray_tpu.init(num_cpus=4)
+        spec = _counter_spec()
+        w = ray_tpu.remote(TrainWorker).remote(0, 1)
+        dag = TrainStepDag([w], spec)
+        hist = dag.run(6)
+        assert [m["sum"] for m in hist] == [i * (i + 1) / 2 for i in range(6)]
+        recs = ray_tpu.get(w.dag_train_records.remote(), timeout=60)
+        dag.teardown()
+        assert recs == [], "resident loop stamped phase records with events off"
+        from ray_tpu.experimental.state import summarize_workloads
+
+        time.sleep(1.0)
+        assert summarize_workloads("train")["total_records"] == 0
+    finally:
+        task_events.set_enabled(True)
