@@ -122,6 +122,14 @@ class Backoff:
             delay = min(delay, max(0.0, self.deadline - time.monotonic()))
         return delay
 
+    def next_delay_or(self, floor: float) -> float:
+        """next_delay() with budget-exhaustion mapped to ``floor``.  A
+        full-jitter sample can legitimately be 0.0 — callers using
+        ``next_delay() or floor`` would silently coerce those to the
+        floor, burning wall-clock their redial window can't spare."""
+        d = self.next_delay()
+        return floor if d is None else d
+
 
 # ------------------------------------------------------------------ fault plan
 
@@ -174,6 +182,7 @@ _POINT_ACTIONS: Dict[str, Tuple[str, ...]] = {
     "wire.read": ("drop", "delay", "sever"),
     "disk.wal.append": ("fail", "short", "delay"),
     "disk.wal.fsync": ("fail", "skip", "delay"),
+    "disk.wal.compact": ("fail", "short", "delay"),
     "disk.spill.write": ("fail", "short", "delay"),
     "disk.spill.read": ("fail", "delay"),
 }

@@ -57,6 +57,10 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "max_object_reconstructions": (int, 3, "re-executions allowed to recover a lost object"),
     "function_fetch_timeout_s": (float, 30.0, "max server-side wait for a function-table KV fetch (widen for chaos/slow CI)"),
     "object_pull_attempts": (int, 3, "backoff-disciplined attempts for a cross-node object pull before declaring it lost"),
+    # -- head fault tolerance (gcs/HEAD_FT.md) --
+    "head_reconnect_window_s": (float, 0.0, "peers (drivers, workers, raylets) redial a lost head connection with backoff for this long before failing typed; 0 preserves fail-fast HeadUnreachableError semantics"),
+    "head_recovery_grace_s": (float, 3.0, "a RESTARTED head holds dispatch this long while live peers re-attach and re-announce state; anything not reconfirmed by the window's end is reaped through the fault FSM / lease revocation / lineage machinery"),
+    "head_reattach_retry_s": (float, 0.25, "client-side pause between re-attach attempts that the head asked to retry (e.g. a worker whose raylet has not re-registered yet)"),
     # -- control-plane fast path: worker-lease caching / raylet dispatch /
     #    sharded GCS (gcs/server.py, raylet/lease_agent.py, gcs/shards.py) --
     "lease_cache_enabled": (bool, True, "drivers/workers cache worker leases per resource shape and push S-shaped task queues straight to the leased worker (head round-trip amortized to ~0 per task)"),

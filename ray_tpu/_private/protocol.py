@@ -188,6 +188,14 @@ class MsgType(enum.IntEnum):
     # arms atomically or not at all (train/jax/step_dag.py).
     DAG_ARM = 113
 
+    # head fault tolerance (gcs/HEAD_FT.md): a live peer that redialed a
+    # RESTARTED head re-announces its identity + held state (role-tagged:
+    # raylet node resources/store, worker running tasks + hosted actor,
+    # driver owned actors + cached leases) so the recovery grace window
+    # can reconcile the replayed WAL state against what actually survived
+    # (reference analog: HandleNotifyGCSRestart, node_manager.cc:1161)
+    REATTACH = 114
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

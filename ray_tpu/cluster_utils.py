@@ -41,6 +41,11 @@ class Cluster:
     ):
         self.head_proc: Optional[subprocess.Popen] = None
         self.worker_nodes: List[NodeHandle] = []
+        # every head ever started (start_new_session ⇒ pid == pgid): a
+        # SIGKILLed head's workers survive it deliberately (head-FT rides
+        # through) and redial for head_reconnect_window_s — shutdown()
+        # reaps those process groups so tests never leak spinning orphans
+        self._head_pgids: List[int] = []
         self.address = ""
         self.session_dir = os.path.join(
             "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
@@ -69,6 +74,9 @@ class Cluster:
             "--resources",
             json.dumps(res),
         ]
+        # (a restarted head reclaims its predecessor's port on its own via
+        # head_meta.json in the session dir — live peers' redial loops
+        # find it at the address they already hold)
         if args.get("object_store_memory"):
             cmd += ["--object-store-memory", str(int(args["object_store_memory"]))]
         logf = open(os.path.join(self.session_dir, "head.log"), "ab")
@@ -76,6 +84,7 @@ class Cluster:
             cmd, stdout=subprocess.PIPE, stderr=logf, start_new_session=True
         )
         self.head_proc = proc
+        self._head_pgids.append(proc.pid)
         deadline = time.time() + 30
         while time.time() < deadline:
             line = proc.stdout.readline()
@@ -172,3 +181,13 @@ class Cluster:
                 except Exception:
                     pass
             self.head_proc = None
+        # reap workers orphaned by head kills (they outlive a SIGKILLed
+        # head by design and redial for head_reconnect_window_s)
+        import signal
+
+        for pgid in self._head_pgids:
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+        self._head_pgids.clear()

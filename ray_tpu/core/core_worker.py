@@ -283,6 +283,38 @@ class CoreWorker:
         self._subscriptions: Dict[str, List[Callable[[dict], None]]] = {}
         self.connected = False
 
+        # --- head fault tolerance (gcs/HEAD_FT.md) ---
+        # set while the head connection is healthy; cleared for the length
+        # of a redial window (head_reconnect_window_s) so head-path RPCs
+        # PARK instead of failing, then either resume on the reattached
+        # conn or fail typed when the window closes
+        self._head_up = threading.Event()
+        self._head_up.set()
+        self._reattach_cbs: List[Callable[[], None]] = []
+        # worker-runtime hook returning {actor, actor_direct_addr,
+        # running} for the reattach announce (installed by worker_main)
+        self._reattach_state_cb: Optional[Callable[[], dict]] = None
+        from collections import OrderedDict as _OrderedDict
+        from collections import deque as _deque
+
+        # task_id -> spec wire for head-path submits whose completion we
+        # haven't observed: resubmitted (idempotency key = task id) after
+        # a reattach so a submit racing the crash is never lost — and
+        # never double-executed (the head dedupes against sealed returns
+        # and worker re-announces).  Bounded; pruned as gets resolve.
+        self._unacked_submits: "_OrderedDict[bytes, dict]" = _OrderedDict()
+        # recent TASK_DONE payloads, replayed (flagged) after a reattach —
+        # the worker can't know which of them the dead head processed
+        self._done_ring: "_deque" = _deque(maxlen=256)
+        # actor ids this driver created (reclaimed on reattach so the
+        # restarted head re-learns ownership)
+        self._owned_actors: set = set()
+        self._worker_reg: dict = {}  # registration echo for reattach
+        self._driver_env: Dict[str, str] = {}
+        # ref-flush batches awaiting re-send after a failed attempt
+        # ((stable batch id, msg type, oids); io-thread only)
+        self._ref_retry_batches: List[tuple] = []
+
         # --- worker-lease cache (control-plane fast path) ---
         # (shape, node_affinity, band) -> _LeasePool: once leases for
         # shape S are held, queues of S-shaped tasks push straight to the
@@ -316,13 +348,13 @@ class CoreWorker:
                 f"{RayConfig.connect_timeout_s:.1f}s dial window: {e}"
             ) from e
         self.store: Optional[ShmObjectStore] = None
-        self.io.spawn(self._read_loop())
+        self.io.spawn(self._read_loop(self.conn))
         self.io.spawn(self._gc_flush_loop())
         if mode == "worker":
             # liveness beacon: a SIGSTOPped/hung worker keeps its TCP socket
             # open, so the head needs missed-beat detection to re-schedule
             # its tasks (analog: reference gcs_heartbeat_manager.h)
-            self.io.spawn(self._heartbeat_loop())
+            self.io.spawn(self._heartbeat_loop(self.conn))
         self.connected = True
         from ray_tpu._private import chaos
 
@@ -380,34 +412,74 @@ class CoreWorker:
         return self.conn
 
     def request(self, msg_type, payload, timeout: Optional[float] = None):
-        """Synchronous control RPC from any thread.  Fails FAST with a
-        typed HeadUnreachableError once the head connection is known dead
-        — graceful degradation instead of every caller hanging out its
-        full rpc timeout against a severed socket."""
+        """Synchronous control RPC from any thread.  While a head redial
+        window is open (head_reconnect_window_s), a lost head connection
+        PARKS the call — it resumes on the reattached conn or fails with
+        a typed HeadUnreachableError when the window closes.  With the
+        window at 0 (the default) the historical fail-fast semantics are
+        preserved: known-dead conn ⇒ immediate typed failure."""
         if self._conn_lost:
             raise HeadUnreachableError(
                 f"head connection lost; {MsgType(msg_type).name} unavailable"
             )
-        conn = self._conn_for(msg_type, payload)
-        try:
-            return self.io.call(
-                conn.request(msg_type, payload, timeout or RayConfig.rpc_timeout_s)
+        return self.io.call(
+            self._head_request_parked(
+                msg_type, payload, timeout or RayConfig.rpc_timeout_s
             )
-        except ConnectionError as e:
-            # only transport loss converts: a remote ERROR_REPLY also
-            # surfaces as ConnectionError but leaves the conn healthy
-            if isinstance(e, HeadUnreachableError):
-                raise
-            if conn is not self.conn and conn.closed:
-                # shard listener gone: permanent fallback to the head (it
-                # keeps every handler), retrying this call there
-                self._shard_conn = None
-                return self.request(msg_type, payload, timeout)
-            if self._conn_lost or self.conn.closed:
+        )
+
+    async def _head_request_parked(
+        self, msg_type, payload, timeout: Optional[float]
+    ):
+        """One control RPC with head-outage parking (io-loop coroutine).
+        Retried RPCs on this path are idempotent by construction: reads
+        (KV_GET/WAIT_OBJECT/...), overwriting writes (KV_PUT), or writes
+        deduped server-side by an idempotency key (CREATE_ACTOR by actor
+        id; SUBMIT rides the resubmit ring instead of this path).  The
+        caller's timeout bounds the TOTAL wait, parking included — a 2s
+        probe must not silently become a 30s reconnect-window stall."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._conn_lost:
                 raise HeadUnreachableError(
-                    f"head connection lost during {MsgType(msg_type).name}: {e}"
-                ) from e
-            raise
+                    f"head connection lost; {MsgType(msg_type).name} unavailable"
+                )
+            if deadline is not None and time.monotonic() >= deadline:
+                raise HeadUnreachableError(
+                    f"head unreachable: {MsgType(msg_type).name} still parked "
+                    f"after its {timeout:.1f}s timeout"
+                )
+            if not self._head_up.is_set():
+                # head mid-restart: park until the redial loop resolves it
+                await asyncio.sleep(0.1)
+                continue
+            conn = self._conn_for(msg_type, payload)
+            try:
+                return await conn.request(msg_type, payload, timeout)
+            except ConnectionError as e:
+                # only transport loss converts: a remote ERROR_REPLY also
+                # surfaces as ConnectionError but leaves the conn healthy
+                if isinstance(e, HeadUnreachableError):
+                    raise
+                if conn is not self.conn and conn.closed:
+                    # shard listener gone: permanent fallback to the head
+                    # (it keeps every handler), retrying this call there
+                    self._shard_conn = None
+                    continue
+                if self._conn_lost:
+                    raise HeadUnreachableError(
+                        f"head connection lost during {MsgType(msg_type).name}: {e}"
+                    ) from e
+                if not self.conn.closed and self._head_up.is_set():
+                    raise  # application error on a healthy conn
+                if RayConfig.head_reconnect_window_s <= 0:
+                    raise HeadUnreachableError(
+                        f"head connection lost during {MsgType(msg_type).name}: {e}"
+                    ) from e
+                # conn died under us with a redial window open: park + retry
+                # (the brief sleep also covers the gap before the read
+                # loop notices the loss and clears _head_up)
+                await asyncio.sleep(0.05)
 
     def _dial_shard(self, addrs):
         """Dial one GCS shard listener (picked by worker-id hash so
@@ -441,11 +513,11 @@ class CoreWorker:
 
         self.io.spawn(_dial())
 
-    async def _read_loop(self):
+    async def _read_loop(self, conn: Connection):
         try:
             while True:
-                msg_type, rid, payload = await self.conn.read_frame()
-                if self.conn.dispatch_reply(msg_type, rid, payload):
+                msg_type, rid, payload = await conn.read_frame()
+                if conn.dispatch_reply(msg_type, rid, payload):
                     continue
                 if msg_type == MsgType.PUSH_TASK:
                     if self._push_task_handler:
@@ -471,13 +543,222 @@ class CoreWorker:
                     # stop pushing, drain, return
                     self._on_lease_revoke(payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            self._conn_lost = True
-            self.connected = False
-            for cb in list(self._disconnect_cbs):
+            self._on_head_conn_lost(conn)
+
+    # --------------------------------- head fault tolerance (reconnect)
+
+    def _on_head_conn_lost(self, conn: Connection):
+        """The head conn's read loop died (io thread).  With a redial
+        window configured this starts the reconnect loop; otherwise it
+        fails fast exactly like the historical path."""
+        if conn is not self.conn or self._conn_lost:
+            return  # stale read loop (conn already replaced) / deliberate
+        window = RayConfig.head_reconnect_window_s
+        if window <= 0:
+            self._fail_head()
+            return
+        if not self._head_up.is_set():
+            return  # reconnect already in flight
+        # NOTE: self.connected stays True while the redial window is open —
+        # the runtime is still attached (APIs park, direct/lease/DAG paths
+        # keep flowing); it drops only when the window closes unrecovered
+        self._head_up.clear()
+        logger.warning(
+            "head connection lost; redialing %s:%s for up to %.1fs",
+            self.head_host,
+            self.head_port,
+            window,
+        )
+        asyncio.get_running_loop().create_task(self._reconnect_head(window))
+
+    def _fail_head(self):
+        """Terminal: the head is gone (no window, or the window closed).
+        Parked callers wake and observe _conn_lost → typed failure."""
+        self._conn_lost = True
+        self.connected = False
+        self._head_up.set()
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        for cb in list(self._disconnect_cbs):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.exception("disconnect callback raised")
+
+    async def _reconnect_head(self, window: float):
+        from ray_tpu._private import chaos as _chaos
+
+        deadline = time.monotonic() + window
+        backoff = _chaos.Backoff(base=0.1, cap=1.0)
+        while True:
+            if self._conn_lost:
+                return  # deliberate disconnect raced the redial
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                logger.error(
+                    "head still unreachable after the %.1fs reconnect window",
+                    window,
+                )
+                self._fail_head()
+                return
+            try:
+                conn = await Connection.connect(
+                    self.head_host, self.head_port, min(rem, 5.0), retry=False
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                delay = backoff.next_delay_or(1.0)
+                await asyncio.sleep(
+                    min(delay, max(0.05, deadline - time.monotonic()))
+                )
+                continue
+            try:
+                await self._do_reattach(conn, deadline)
+            except Exception:  # noqa: BLE001
+                logger.warning("head reattach attempt failed; retrying", exc_info=True)
+                conn.close()
+                delay = backoff.next_delay_or(1.0)
+                await asyncio.sleep(
+                    min(delay, max(0.05, deadline - time.monotonic()))
+                )
+                continue
+            return
+
+    async def _do_reattach(self, conn: Connection, deadline: float):
+        """Announce ourselves to the (restarted) head on a fresh conn and
+        resume service: swap the conn, re-subscribe, replay unacked
+        completions, resubmit unacked head-path submits (idempotency key:
+        task id), wake every parked waiter."""
+        # the reply needs a live read loop for this conn; if reattach
+        # fails the loop dies with the closed conn and is ignored
+        # (stale-conn guard in _on_head_conn_lost)
+        asyncio.get_running_loop().create_task(self._read_loop(conn))
+        with self._lease_lock:
+            leases = [
+                {
+                    "lease_id": l.lease_id,
+                    "worker_id": l.worker_id,
+                    "resources": dict(l.shape),
+                    "priority": int(l.pool.key[2]),
+                }
+                for l in self._lease_by_id.values()
+                if l.grantor == "head" and not l.returned
+            ]
+        payload: Dict[str, Any] = {
+            "pid": os.getpid(),
+            # BOTH roles re-claim: a worker-hosted actor (e.g. the serve
+            # controller) owns the actors it created just like a driver —
+            # skipping its claim would owner-reap them at reconciliation
+            "owned_actors": sorted(self._owned_actors),
+            "leases": leases,
+        }
+        if self.mode == "worker":
+            payload.update(
+                {
+                    "role": "worker",
+                    "worker_id": self.worker_id.binary(),
+                    "node_id": self.node_id,
+                }
+            )
+            payload.update(self._worker_reg)
+            if self._reattach_state_cb is not None:
                 try:
-                    cb()
+                    payload.update(self._reattach_state_cb() or {})
                 except Exception:  # noqa: BLE001
-                    logger.exception("disconnect callback raised")
+                    logger.exception("reattach state provider raised; announcing bare")
+        else:
+            payload.update(
+                {
+                    "role": "driver",
+                    "job_id": self.job_id.binary(),
+                    "worker_env": self._driver_env,
+                }
+            )
+        while True:
+            reply = await conn.request(MsgType.REATTACH, payload, 10)
+            if reply.get("ok"):
+                break
+            if reply.get("retry") and time.monotonic() < deadline:
+                # e.g. a worker whose raylet hasn't re-registered yet
+                await asyncio.sleep(RayConfig.head_reattach_retry_s)
+                continue
+            raise ConnectionError(f"head rejected reattach: {reply!r}")
+        old = self.conn
+        self.conn = conn
+        old.close()
+        self._shard_conn = None
+        self._dial_shard(reply.get("shard_addrs") or [])
+        if reply.get("store_path") and not reply.get("store_preserved", True):
+            # the head recreated its store segment (the survivor was
+            # unusable): our mmap points at the dead inode — re-attach or
+            # every later put/seal lands in a segment the head never reads
+            try:
+                self.attach_store(reply["store_path"])
+            except Exception:  # noqa: BLE001
+                logger.exception("store re-attach after head restart failed")
+        if self.mode == "worker":
+            asyncio.get_running_loop().create_task(self._heartbeat_loop(conn))
+        for channel in list(self._subscriptions):
+            await conn.send(MsgType.SUBSCRIBE, {"channel": channel})
+        # replay completions the dead head may never have processed (the
+        # head dedupes via its recent-done ring / sealed returns); snapshot
+        # under the lock — executor/user threads mutate both rings
+        with self._refs_lock:
+            adds, self._pending_adds = self._pending_adds, []
+            dones = list(self._done_ring)
+            unacked = list(self._unacked_submits.values())
+        # ref flushes STILL land before completions on the new conn: a
+        # TASK_DONE replay unpins args — a late ADD_REF behind it could
+        # resurrect a count on an already-freed object.  Batches keep
+        # their id across attempts (io-thread only), so a send whose
+        # first try raced delivery dedupes head-side instead of
+        # double-counting.
+        ref_batches = self._ref_retry_batches
+        self._ref_retry_batches = []
+        if adds:
+            ref_batches.append((os.urandom(8), MsgType.ADD_REF, adds))
+        if ref_batches:
+            try:
+                for bid, mtype, oids in ref_batches:
+                    await conn.send(mtype, {"object_ids": oids, "batch": bid})
+            except Exception:
+                self._ref_retry_batches = ref_batches
+                raise
+        for done in dones:
+            await conn.send(MsgType.TASK_DONE, dict(done, replay=True))
+        # resubmit unacked submits — never double-executed: the head
+        # dedupes by task id against sealed returns and re-announced
+        # running tasks, parking verdicts until its grace window closes
+        for wire in unacked:
+            await conn.send(MsgType.SUBMIT_TASK, {"spec": wire, "resubmit": True})
+        self.connected = True
+        self._head_up.set()
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        logger.info(
+            "reattached to head (incarnation %s) after restart",
+            reply.get("incarnation"),
+        )
+        if self._reattach_cbs:
+            cbs = list(self._reattach_cbs)
+
+            def _fire():
+                for cb in cbs:
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("reattach callback raised")
+
+            threading.Thread(target=_fire, name="head-reattach-cbs", daemon=True).start()
+
+    def on_reattach(self, cb: Callable[[], None]):
+        """Invoke cb (dedicated thread) after every successful head
+        reattach — e.g. the serve controller re-syncing replica state."""
+        self._reattach_cbs.append(cb)
+
+    def set_reattach_state_provider(self, cb: Callable[[], dict]):
+        """Worker-runtime hook: returns the reattach announce extras
+        ({actor, actor_direct_addr, running: [spec wires]})."""
+        self._reattach_state_cb = cb
 
     def on_disconnect(self, cb: Callable[[], None]):
         """Invoke cb (io thread) when the head connection drops — a worker
@@ -581,12 +862,14 @@ class CoreWorker:
         except Exception:  # graftlint: disable=silent-except -- fault events are best-effort observability; the local chaos.fired() log is authoritative
             pass
 
-    async def _heartbeat_loop(self):
+    async def _heartbeat_loop(self, conn: Connection):
+        """Beats ride one specific conn and die with it — a successful
+        reattach starts a fresh loop on the new conn."""
         period = RayConfig.heartbeat_period_ms / 1000.0
         try:
-            while True:
+            while conn is self.conn:
                 await asyncio.sleep(period)
-                await self.conn.send(
+                await conn.send(
                     MsgType.HEARTBEAT, {"worker_id": self.worker_id.binary()}
                 )
         except (ConnectionError, OSError):
@@ -595,26 +878,38 @@ class CoreWorker:
     async def _gc_flush_loop(self):
         while True:
             await asyncio.sleep(0.2)
-            adds = removals = None
+            if not self._head_up.is_set():
+                continue  # head mid-restart: keep batching, flush after
+            # adds flush BEFORE removals so this process's +/- pairs can
+            # never transiently go negative at the head.  Each batch keeps
+            # a STABLE id across retries (the head dedupes re-sends whose
+            # first attempt raced a conn loss after processing); a failed
+            # batch re-queues FIFO so a head-restart window loses nothing.
+            batches = self._ref_retry_batches
+            self._ref_retry_batches = []
             with self._refs_lock:
                 if self._pending_adds:
-                    adds, self._pending_adds = self._pending_adds, []
+                    batches.append(
+                        (os.urandom(8), MsgType.ADD_REF, self._pending_adds)
+                    )
+                    self._pending_adds = []
                 if self._pending_removals:
-                    removals, self._pending_removals = self._pending_removals, []
-            # adds flush BEFORE removals so this process's +/- pairs can
-            # never transiently go negative at the head
-            if adds:
-                try:
-                    await self.conn.request(MsgType.ADD_REF, {"object_ids": adds}, 10)
-                except Exception:  # graftlint: disable=silent-except -- head connection lost; the disconnect callback path owns shutdown
-                    pass
-            if removals:
+                    batches.append(
+                        (os.urandom(8), MsgType.REMOVE_REF, self._pending_removals)
+                    )
+                    self._pending_removals = []
+            for i, (bid, mtype, oids) in enumerate(batches):
                 try:
                     await self.conn.request(
-                        MsgType.REMOVE_REF, {"object_ids": removals}, 10
+                        mtype, {"object_ids": oids, "batch": bid}, 10
                     )
-                except Exception:  # graftlint: disable=silent-except -- head connection lost; the disconnect callback path owns shutdown
-                    pass
+                except Exception:  # graftlint: disable=silent-except -- tail re-queued in order below; window 0 ⇒ the disconnect callback path owns shutdown
+                    # keep the ordered tail for the next tick (attempting
+                    # later batches after a failure could land removals
+                    # ahead of their adds)
+                    if not self._conn_lost:
+                        self._ref_retry_batches = batches[i:]
+                    break
 
     # ------------------------------------------------------------- refcounts
 
@@ -638,6 +933,17 @@ class CoreWorker:
                 # direct-call results live only in this process: last local
                 # ref gone = value unreachable
                 self._memory_store.pop(oid, None)
+                # head-FT: a fire-and-forget submit retires once NO return
+                # ref survives — nobody awaits it, so replaying it after a
+                # reattach could only double-run its side effects
+                # (ObjectID = task_id(24) + return index)
+                tid = oid[:24]
+                wire = self._unacked_submits.get(tid)
+                if wire is not None and not any(
+                    tid + i.to_bytes(4, "little") in self._local_refs
+                    for i in range(int(wire.get("num_returns", 1)))
+                ):
+                    self._unacked_submits.pop(tid, None)
             else:
                 self._local_refs[oid] = n
 
@@ -854,7 +1160,7 @@ class CoreWorker:
                     async def _fetch_all():
                         return await asyncio.gather(
                             *[
-                                self.conn.request(
+                                self._head_request_parked(
                                     MsgType.CLIENT_GET,
                                     {"object_id": oid, "timeout": rem},
                                     (rem + 10) if rem is not None else 3600,
@@ -913,7 +1219,7 @@ class CoreWorker:
                     async def _wait_all():
                         return await asyncio.gather(
                             *[
-                                self.conn.request(
+                                self._head_request_parked(
                                     MsgType.WAIT_OBJECT,
                                     {"object_id": oid, "timeout": rem, "node_id": self.node_id},
                                     (rem + 5) if rem is not None else 3600,
@@ -935,6 +1241,14 @@ class CoreWorker:
                         out[i] = self._materialize(sobj)
             finally:
                 self._notify_blocked(False)
+        if self._unacked_submits:
+            # resolved results retire their submit from the head-FT
+            # resubmit ring: a completed-and-observed task must never be
+            # replayed after a reattach
+            with self._refs_lock:
+                for ref in refs:
+                    if isinstance(ref, ObjectRef):
+                        self._unacked_submits.pop(ref.task_id().binary(), None)
         return out
 
     def _refetch_evicted(self, oid: bytes, deadline: Optional[float]) -> SerializedObject:
@@ -969,6 +1283,8 @@ class CoreWorker:
     def _notify_blocked(self, blocked: bool):
         if self.mode != "worker" or not self.current_task_id:
             return
+        if not self._head_up.is_set():
+            return  # head mid-restart: advisory accounting, skip
         try:
             self.io.spawn(
                 self.conn.send(
@@ -1043,7 +1359,7 @@ class CoreWorker:
                     "timeout": rem_,
                 }
                 fut = self.io.spawn(
-                    self._conn_for(MsgType.WAIT_OBJECT, wait_payload).request(
+                    self._head_request_parked(
                         MsgType.WAIT_OBJECT,
                         wait_payload,
                         (rem_ + 10) if rem_ is not None else 3600,
@@ -1176,11 +1492,21 @@ class CoreWorker:
         free() (releases containment pins on nested refs we may have just
         deserialized).  The 200ms batched flush must not lose that race:
         a late ADD_REF would resurrect a count on an already-freed object."""
+        if not self._head_up.is_set():
+            # head mid-restart: its refcount table died with it anyway —
+            # blocking a (possibly lease-path, head-free) completion on
+            # reconnect would stall flows that don't need the head
+            return
         with self._refs_lock:
             adds, self._pending_adds = self._pending_adds, []
         if adds:
             try:
-                self.request(MsgType.ADD_REF, {"object_ids": adds})
+                # stable batch id: the parked path re-sends this same
+                # payload after a reattach, and the head dedupes a first
+                # attempt that raced the crash after being applied
+                self.request(
+                    MsgType.ADD_REF, {"object_ids": adds, "batch": os.urandom(8)}
+                )
             except Exception:  # graftlint: disable=silent-except -- head connection lost; refs die with the head anyway
                 pass
 
@@ -1311,6 +1637,9 @@ class CoreWorker:
             preemptible=bool(preemptible),
         )
         self.request(MsgType.CREATE_ACTOR, {"spec": spec.to_wire()})
+        # reclaimed on reattach so a restarted head re-learns ownership
+        # (owner-death cleanup keys off the owner's conn)
+        self._owned_actors.add(bytes(actor_id))
         return ObjectRef(spec.return_object_ids()[0], self)
 
     def submit_actor_task(
@@ -1374,8 +1703,15 @@ class CoreWorker:
         flush coroutine drains whatever accumulated by the time the io
         loop runs it, so a tight submission loop pays ~one frame per loop
         wakeup instead of one per task (order preserved)."""
+        wire = spec.to_wire()
         with self._refs_lock:
-            self._submit_buffer.append(spec.to_wire())
+            self._submit_buffer.append(wire)
+            # head-FT resubmit ring: held until a get() observes the
+            # result (or FIFO eviction); replayed with resubmit=True
+            # after a reattach, deduped head-side by task id
+            self._unacked_submits[bytes(spec.task_id)] = wire
+            while len(self._unacked_submits) > 4096:
+                self._unacked_submits.popitem(last=False)
             if self._submit_flush_scheduled:
                 return
             self._submit_flush_scheduled = True
@@ -1387,10 +1723,16 @@ class CoreWorker:
             self._submit_flush_scheduled = False
         if not batch:
             return
-        if len(batch) == 1:
-            await self.conn.send(MsgType.SUBMIT_TASK, {"spec": batch[0]})
-        else:
-            await self.conn.send(MsgType.SUBMIT_TASKS, {"specs": batch})
+        try:
+            if len(batch) == 1:
+                await self.conn.send(MsgType.SUBMIT_TASK, {"spec": batch[0]})
+            else:
+                await self.conn.send(MsgType.SUBMIT_TASKS, {"specs": batch})
+        except (ConnectionError, OSError):
+            if RayConfig.head_reconnect_window_s <= 0 or self._conn_lost:
+                raise
+            # head mid-restart: the batch survives in _unacked_submits and
+            # rides the post-reattach resubmit replay
 
     # ------------------------------------- worker-lease cache (fast path)
 
@@ -1531,6 +1873,12 @@ class CoreWorker:
 
     def _request_lease(self, pool: _LeasePool) -> Optional[_Lease]:
         shape, affinity, band = pool.key
+        if not self._head_up.is_set():
+            # head mid-restart: deny fast so the pump deepens the leases
+            # it already holds (the head-free flow the outage must not
+            # stall) instead of parking pool growth on the redial
+            pool.denied_at = time.monotonic()
+            return None
         try:
             payload = {
                 "resources": dict(shape),
@@ -1773,9 +2121,12 @@ class CoreWorker:
                     )
                     continue
                 wire["retries_left"] = rl - 1
-            # resubmit through the head: it owns placement from here
-            wire["granted_by"] = "head"
-            self.io.spawn(self.conn.send(MsgType.SUBMIT_TASK, {"spec": wire}))
+            # resubmit through the head: it owns placement from here.
+            # Ring first — if the head is mid-restart the send fails and
+            # the post-reattach resubmit replay is what delivers it.
+            with self._refs_lock:
+                self._unacked_submits[bytes(tid)] = wire
+            self.io.spawn(self._send_submit_best_effort(wire))
         # wake waiters AFTER the resubmits are queued on the ordered conn:
         # their follow-up WAIT_OBJECT can then never race ahead of the
         # resubmit frame
@@ -1794,6 +2145,13 @@ class CoreWorker:
         # tasks still waiting in the pool queue re-route (fresh lease or
         # head path)
         self._pump_lease_pool(lease.pool)
+
+    async def _send_submit_best_effort(self, wire: dict):
+        try:
+            await self.conn.send(MsgType.SUBMIT_TASK, {"spec": wire})
+        except (ConnectionError, OSError):
+            # head down: the wire is in _unacked_submits; reattach replays
+            pass
 
     def _seal_local_error(self, oids, wire, cause: Exception):
         err = serialization.serialize(
@@ -2030,9 +2388,7 @@ class CoreWorker:
     async def _watch_object(self, oid: bytes):
         try:
             payload = {"object_id": oid, "timeout": None}
-            await self._conn_for(MsgType.WAIT_OBJECT, payload).request(
-                MsgType.WAIT_OBJECT, payload, 3600
-            )
+            await self._head_request_parked(MsgType.WAIT_OBJECT, payload, 3600)
         except Exception:  # graftlint: disable=silent-except -- watch is best-effort; callbacks fire regardless so waiters re-check the store
             pass
         self._fire_done_callbacks(oid)
@@ -2152,6 +2508,7 @@ class CoreWorker:
         return self.request(MsgType.GET_ACTOR, {"name": name, "namespace": namespace})
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self._owned_actors.discard(bytes(actor_id))
         self.request(MsgType.KILL_ACTOR, {"actor_id": actor_id, "no_restart": no_restart})
 
     def cancel_task(self, task_id: bytes, force: bool = False):
@@ -2294,12 +2651,15 @@ class CoreWorker:
                 "direct_addr": direct_addr,
             },
         )
+        # registration echo for a post-restart reattach announce
+        self._worker_reg = {"has_tpu": has_tpu, "direct_addr": direct_addr}
         self.node_id = node_id
         self.attach_store(reply["store_path"])
         self._dial_shard(reply.get("shard_addrs") or [])
         return reply
 
     def register_as_driver(self, worker_env: Dict[str, str]):
+        self._driver_env = dict(worker_env or {})
         reply = self.request(
             MsgType.REGISTER_JOB,
             {
@@ -2336,29 +2696,36 @@ class CoreWorker:
         # on TASK_DONE, or the batched add could lose the race with a
         # driver-side delete
         self.flush_ref_adds()
-        self.io.call(
-            self.conn.send(
-                MsgType.TASK_DONE,
-                {
-                    "task_id": task_id,
-                    "sealed": sealed,
-                    "error": error,
-                    "stored_error": stored_error,
-                    "exec_start": exec_start,
-                    "exec_end": exec_end,
-                    # refs pickled inside each sealed return value → the head
-                    # pins them for the return object's lifetime
-                    "contained": contained or {},
-                    # flight-recorder stamps accumulated across the hops
-                    # (task_events.py); None/{} when recording is off
-                    "phases": phases or {},
-                },
-            )
-        )
+        payload = {
+            "task_id": task_id,
+            "sealed": sealed,
+            "error": error,
+            "stored_error": stored_error,
+            "exec_start": exec_start,
+            "exec_end": exec_end,
+            # refs pickled inside each sealed return value → the head
+            # pins them for the return object's lifetime
+            "contained": contained or {},
+            # flight-recorder stamps accumulated across the hops
+            # (task_events.py); None/{} when recording is off
+            "phases": phases or {},
+        }
+        # ring first: if the send races a head crash, the post-reattach
+        # replay re-delivers it (flagged; the head applies at most once).
+        # Under the lock: the reattach path snapshots the ring concurrently.
+        with self._refs_lock:
+            self._done_ring.append(payload)
+        try:
+            self.io.call(self.conn.send(MsgType.TASK_DONE, payload))
+        except (ConnectionError, OSError):
+            if RayConfig.head_reconnect_window_s <= 0 or self._conn_lost:
+                raise
+            # head mid-restart: the completion survives in the ring
 
     def disconnect(self):
         self.connected = False
         self._conn_lost = True  # post-disconnect RPCs fail fast and typed
+        self._head_up.set()  # wake parked head-FT waiters into the typed path
         for c in list(self._direct_conns.values()):
             try:
                 c.close()

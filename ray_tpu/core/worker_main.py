@@ -36,6 +36,9 @@ class _ActorState:
         self.cls: Any = None
         self.async_loop: Optional[Any] = None  # asyncio loop for async actors
         self.executor: Optional[ThreadPoolExecutor] = None
+        # creation spec wire, kept for the head-FT reattach announce (a
+        # restarted head re-learns this worker hosts the actor)
+        self.spec_wire: Optional[dict] = None
 
 
 class WorkerRuntime:
@@ -76,6 +79,15 @@ class WorkerRuntime:
         # next per caller_id, plus held-back out-of-order specs
         self._expected_seq: Dict[bytes, int] = {}
         self._held: Dict[bytes, Dict[int, dict]] = {}
+        # head-pushed tasks between push and their TASK_DONE flush, spec
+        # wire by task id: re-announced on a head-FT reattach so the
+        # restarted head re-owns them instead of treating the driver's
+        # idempotent resubmit as fresh work (double execution).  Locked:
+        # io thread inserts, executor threads retire, the reattach
+        # coroutine snapshots — an unlocked snapshot can raise mid-announce
+        # and leave the actor un-re-announced (ghost-reaped while alive)
+        self._head_inflight: Dict[bytes, dict] = {}
+        self._head_inflight_lock = threading.Lock()
 
     # ------------------------------------------------------------ main loop
 
@@ -167,7 +179,33 @@ class WorkerRuntime:
         """Called from the io thread; never block it."""
         if payload.get("directive"):
             return  # spawn directives are raylet business, not ours
+        wire = payload.get("spec")
+        if (
+            wire is not None
+            and "direct" not in payload
+            and "lease" not in payload
+        ):
+            # head-path task: tracked from PUSH (a queued-but-unstarted
+            # task must also be re-announced after a head restart, or the
+            # driver's resubmit would race this copy — double execution)
+            tid = bytes(wire.get("task_id") or b"")
+            if tid:
+                with self._head_inflight_lock:
+                    self._head_inflight[tid] = wire
         self.task_queue.put(payload)
+
+    def reattach_state(self) -> dict:
+        """Head-FT reattach announce (core_worker calls this on redial):
+        the hosted actor (if any) + every head-path task still owed a
+        TASK_DONE."""
+        out: Dict[str, Any] = {}
+        if self.actor.instance is not None and self.actor.spec_wire is not None:
+            out["actor"] = self.actor.spec_wire
+            if self._direct_port:
+                out["actor_direct_addr"] = f"0.0.0.0:{self._direct_port}"
+        with self._head_inflight_lock:
+            out["running"] = list(self._head_inflight.values())
+        return out
 
     # --------------------------------------- lease fast path (batched IO)
 
@@ -391,6 +429,10 @@ class WorkerRuntime:
         try:
             self._execute_guarded_inner(spec, reply_to)
         finally:
+            # retire AFTER the TASK_DONE flush inside the inner call: the
+            # reattach announce must cover a completion still in flight
+            with self._head_inflight_lock:
+                self._head_inflight.pop(bytes(spec.task_id), None)
             with self._inflight_cv:
                 self._inflight -= 1
                 self._inflight_cv.notify_all()
@@ -573,6 +615,7 @@ class WorkerRuntime:
         if spec.task_type == ACTOR_CREATION_TASK:
             cls = self.cw.fetch_function(spec.function_id)
             self.actor.cls = cls
+            self.actor.spec_wire = spec.to_wire()
             if _is_async_actor(cls):
                 # async actors process calls concurrently on one event loop
                 # (reference: fiber-based async actors, core_worker fiber.h;
@@ -839,6 +882,7 @@ def main():
     # the moment registration lands
     cw.set_push_task_handler(runtime.on_push)
     cw.set_preempt_handler(runtime.on_preempt)
+    cw.set_reattach_state_provider(runtime.reattach_state)
     # every worker serves direct calls now (lease pushes + actor calls);
     # the address rides registration so the head can grant leases on it
     direct_port = 0

@@ -459,6 +459,55 @@ class HeadServer:
         self.profile_stack_dumps: List[dict] = []
         self.profile_ctrl: Optional[dict] = None
 
+        # ---- head fault tolerance (gcs/HEAD_FT.md) ----
+        # per-boot incarnation: 1 on a fresh session, +1 per restart in
+        # the same session dir (persisted in head_meta.json + WAL'd)
+        self.incarnation = 1
+        self.started_at = time.time()
+        # active recovery grace window (None when not recovering): holds
+        # dispatch while live peers re-attach; state not reconfirmed by
+        # the deadline is reaped through the existing fault machinery
+        self._recovery: Optional[dict] = None
+        self.last_recovery: Optional[dict] = None
+        # resubmits / actor calls / lease restores parked until the grace
+        # window closes (reconciliation decides dedupe vs enqueue)
+        self._recovery_resubmits: List[Tuple[int, dict]] = []
+        self._recovery_actor_calls: List[TaskSpec] = []
+        # holder-announced leases whose worker hasn't reattached yet,
+        # keyed by worker id and drained when that worker announces — a
+        # standing structure (NOT recovery-scoped) because a worker's
+        # redial can outlast the grace window
+        self._pending_lease_restores: Dict[bytes, List[Tuple[int, dict]]] = {}
+        # driver-announced actor ownership claims: applied immediately to
+        # known actors, and retained so a WORKER announce that lands after
+        # its owner's reattach still binds to the right conn
+        self._owner_claims: Dict[bytes, int] = {}
+        self._reattach_stats = {
+            "nodes": 0,
+            "workers": 0,
+            "drivers": 0,
+            "actors": 0,
+            "tasks": 0,
+            "leases": 0,
+        }
+        # TASK_DONE replay dedupe: a reattached worker re-sends its recent
+        # completions (the head may or may not have processed them before
+        # the crash / conn loss) — processing one twice would double-pin
+        # contained refs and double-count metrics
+        self._recent_dones: Set[bytes] = set()
+        from collections import deque as _deque
+
+        self._recent_dones_fifo: "_deque" = _deque(maxlen=8192)
+        # ref-batch dedupe: clients tag ADD_REF/REMOVE_REF flushes with a
+        # batch id and re-send after a conn loss (the loss may have raced
+        # the reply) — a counter bump is not idempotent, so dedupe here
+        self._ref_batches: Set[bytes] = set()
+        self._ref_batches_fifo: "_deque" = _deque(maxlen=4096)
+        # True on a restarted head: pre-crash client refcounts were never
+        # re-announced, so an ABSENT count is "unknown", not zero
+        self._refs_amnesic = False
+        self._store_preserved = False
+
         self._conn_seq = 0
         self._last_beat: Dict[int, float] = {}
         self._conns: Dict[int, Connection] = {}
@@ -475,8 +524,53 @@ class HeadServer:
 
     # ------------------------------------------------------------------ setup
 
+    def _load_head_meta(self) -> Optional[dict]:
+        """One-shot boot IO (before any client is served): the previous
+        incarnation's identity record, or None on a fresh session."""
+        import json as _json
+
+        try:
+            with open(self._head_meta_path) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save_head_meta(self):
+        """Persist identity for the NEXT incarnation (atomic replace);
+        one-shot boot IO, runs before any client traffic is accepted."""
+        import json as _json
+
+        try:
+            tmp = self._head_meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump(
+                    {
+                        "node_id": self.head_node_id.hex(),
+                        "port": self.port,
+                        "incarnation": self.incarnation,
+                        "pid": os.getpid(),
+                    },
+                    f,
+                )
+            os.replace(tmp, self._head_meta_path)
+        except OSError:
+            logger.warning("head_meta.json write failed; restarts lose identity", exc_info=True)
+
     async def start(self) -> int:
         os.makedirs(self.session_dir, exist_ok=True)
+        # head identity persistence: a restarted head in the SAME session
+        # dir adopts its predecessor's node id (so surviving workers'
+        # RAY_TPU_NODE_ID and the replayed object directory stay valid),
+        # its listen port when none was pinned (so peers' redial loops
+        # find it), and the next incarnation number
+        self._head_meta_path = os.path.join(self.session_dir, "head_meta.json")
+        prev_meta = self._load_head_meta()
+        if prev_meta:
+            try:
+                self.head_node_id = bytes.fromhex(prev_meta["node_id"])
+                self.incarnation = int(prev_meta.get("incarnation", 1)) + 1
+            except (KeyError, ValueError):
+                prev_meta = None
         # chaos scope + env-armed plan; fired faults land in the cluster
         # event ring directly (this process OWNS the ring)
         chaos.maybe_init_from_env("head")
@@ -512,7 +606,24 @@ class HeadServer:
         from ray_tpu.core.shm_store import ShmObjectStore
         from ray_tpu.raylet.object_agent import ObjectTransferAgent
 
-        self._store = ShmObjectStore(self.store_path, capacity=self.store_capacity, create=True)
+        # a restarted head ATTACHES to the surviving store segment instead
+        # of recreating it: objects produced before the crash stay
+        # readable, and surviving workers' mmaps of the same file remain
+        # coherent (recreating would silently split-brain them)
+        self._store_preserved = False
+        if prev_meta and os.path.exists(self.store_path):
+            try:
+                self._store = ShmObjectStore(self.store_path, create=False)
+                self._store_preserved = True
+            except OSError:
+                logger.warning(
+                    "surviving store segment unusable; recreating (its "
+                    "objects are lost — lineage/spill recovery applies)"
+                )
+        if not self._store_preserved:
+            self._store = ShmObjectStore(
+                self.store_path, capacity=self.store_capacity, create=True
+            )
         if RayConfig.object_spilling_enabled:
             loop = asyncio.get_running_loop()
             spill_dir = self.store_path + ".spill"
@@ -589,8 +700,27 @@ class HeadServer:
         except Exception as e:  # noqa: BLE001
             logger.warning("head metrics endpoint unavailable: %s", e)
 
-        self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
+        if self.port == 0 and prev_meta and prev_meta.get("port"):
+            # reclaim the predecessor's port so peers' redial loops reach
+            # us without rediscovery; fall back to an ephemeral port if
+            # something else grabbed it (peers then fail their window —
+            # same as a head that never came back)
+            try:
+                self._server = await asyncio.start_server(
+                    self._on_connection, self.host, int(prev_meta["port"])
+                )
+            except OSError:
+                logger.warning(
+                    "predecessor port %s unavailable; binding ephemeral",
+                    prev_meta["port"],
+                )
+                self._server = await asyncio.start_server(
+                    self._on_connection, self.host, 0
+                )
+        else:
+            self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._save_head_meta()
 
         # tail this node's worker logs → "logs" pubsub channel (analog:
         # reference log_monitor.py; drivers subscribe when log_to_driver)
@@ -615,10 +745,44 @@ class HeadServer:
 
         self._storage = GcsWalStorage(self.session_dir)
         self._compact_lock = asyncio.Lock()
+        # recovery grace window: a RESTARTED head holds dispatch while
+        # live peers redial and re-announce; set BEFORE restore so the
+        # replayed detached-actor creations park for reclaim instead of
+        # immediately respawning actors whose workers may still be alive
+        if self.incarnation > 1:
+            # pre-crash client refs were never re-announced: an absent
+            # refcount must err toward retention, not deletion
+            self._refs_amnesic = True
+        if self.incarnation > 1 and RayConfig.head_recovery_grace_s > 0:
+            self._recovery = {
+                "started": time.time(),
+                "deadline": time.time() + RayConfig.head_recovery_grace_s,
+                "unclaimed_actors": set(),
+            }
+            # stats cover THIS window only (a second restart must not
+            # re-report the first recovery's reattaches)
+            self._reattach_stats = {k: 0 for k in self._reattach_stats}
         self._restore_tables()
         # identity record: lets the NEXT incarnation remap directory/spill
         # entries that point at THIS head's (ephemeral) store segment
         self._wal("head", self.head_node_id)
+        self._wal("boot", self.incarnation, time.time())
+        if self.incarnation > 1:
+            self._record_event(
+                "WARNING",
+                "head",
+                f"head restarted (incarnation {self.incarnation}, store "
+                f"{'preserved' if self._store_preserved else 'recreated'})",
+                incarnation=self.incarnation,
+            )
+            self._inc_counter(
+                "ray_tpu_head_restarts_total",
+                "head process restarts within this session",
+                {},
+                1.0,
+            )
+            if self._recovery is not None:
+                asyncio.get_running_loop().create_task(self._recovery_window())
 
         # SLO specs can be seeded from the environment (operators without a
         # driver attached yet); a later slo_api.set_slos replaces them
@@ -719,10 +883,55 @@ class HeadServer:
             "sealed": [o for o, e in self.objects.items() if e[0] == SEALED],
         }
 
+    def _quarantine_wal(self, reason: str):
+        """Move the corrupt WAL segments aside so fresh appends start on a
+        clean log and the NEXT restart doesn't re-fail on the same bytes."""
+        for path in (self._storage.rotated_path, self._storage.wal_path):
+            if os.path.exists(path):
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    logger.exception("could not quarantine corrupt WAL %s", path)
+        self._record_event(
+            "ERROR",
+            "head",
+            f"WAL corrupt mid-file; recovered from snapshot only ({reason})",
+        )
+
     def _restore_tables(self):
-        tables, records = self._storage.load()
+        from ray_tpu.gcs.storage import WalCorruptionError
+
+        try:
+            tables, records = self._storage.load()
+        except WalCorruptionError as e:
+            # mid-file corruption: replaying a reordered suffix can
+            # resurrect deleted state — recover the snapshot alone, loudly
+            logger.error("WAL replay aborted: %s — falling back to snapshot-only recovery", e)
+            tables, records = self._storage.base.load(), []
+            self._quarantine_wal(str(e))
         if not tables and not records:
             return
+        st, old_heads = self._seed_state_from_tables(tables)
+        # replay the WAL over the base state, newest wins.  A record that
+        # fails to APPLY is corruption just like a bad crc: skipping it
+        # while applying later records reorders state, so the whole replay
+        # is abandoned for snapshot-only recovery (positional contract,
+        # same as storage._replay_file).
+        try:
+            self._apply_wal_records(st, records, old_heads)
+        except Exception as e:  # noqa: BLE001
+            logger.error(
+                "WAL record failed to apply — falling back to snapshot-only "
+                "recovery",
+                exc_info=True,
+            )
+            self._quarantine_wal(f"unappliable record: {type(e).__name__}: {e}")
+            st, old_heads = self._seed_state_from_tables(tables)
+            records = []
+        self._materialize_restored(st, old_heads, len(records))
+
+    @staticmethod
+    def _seed_state_from_tables(tables) -> Tuple[dict, set]:
         st = {
             "kv": {},
             "jobs": {},
@@ -753,58 +962,63 @@ class HeadServer:
                 {bytes(o): w for o, w in tables.get("lineage", {}).items()}
             )
             st["sealed"].update(bytes(o) for o in tables.get("sealed", []))
-        # replay the WAL over the base state, newest wins
+        return st, old_heads
+
+    @staticmethod
+    def _apply_wal_records(st: dict, records: List[Tuple], old_heads: set):
         for rec in records:
             kind = rec[0]
-            try:
-                if kind == "kv":
-                    if rec[2] is None:
-                        st["kv"].pop(rec[1], None)
-                    else:
-                        st["kv"][rec[1]] = rec[2]
-                elif kind == "job":
-                    st["jobs"][rec[1]] = rec[2]
-                elif kind == "dactor":
-                    if rec[2] is None:
-                        st["detached"].pop(bytes(rec[1]), None)
-                    else:
-                        st["detached"][bytes(rec[1])] = rec[2]
-                elif kind == "pg":
-                    if rec[2] is None:
-                        st["pgs"].pop(bytes(rec[1]), None)
-                    else:
-                        st["pgs"][bytes(rec[1])] = tuple(rec[2])
-                elif kind == "seal":
-                    st["sealed"].add(bytes(rec[1]))
-                elif kind == "loc=":
-                    locs = {bytes(x) for x in rec[2]}
-                    if locs:
-                        st["locs"][bytes(rec[1])] = locs
-                    else:
-                        st["locs"].pop(bytes(rec[1]), None)
-                elif kind == "spill":
-                    if rec[2] is None:
-                        st["spilled"].pop(bytes(rec[1]), None)
-                    else:
-                        st["spilled"][bytes(rec[1])] = tuple(rec[2])
-                elif kind == "lineage":
-                    if rec[2] is None:
-                        st["lineage"].pop(bytes(rec[1]), None)
-                    else:
-                        st["lineage"][bytes(rec[1])] = rec[2]
-                elif kind == "obj-":
-                    oid = bytes(rec[1])
-                    st["locs"].pop(oid, None)
-                    st["spilled"].pop(oid, None)
-                    st["sealed"].discard(oid)
-                elif kind == "head":
-                    old_heads.add(bytes(rec[1]))
-            except Exception:  # noqa: BLE001
-                logger.warning(
-                    "skipping corrupt WAL record during replay", exc_info=True
-                )
-                continue
-        # ---- materialize
+            if kind == "kv":
+                if rec[2] is None:
+                    st["kv"].pop(rec[1], None)
+                else:
+                    st["kv"][rec[1]] = rec[2]
+            elif kind == "job":
+                st["jobs"][rec[1]] = rec[2]
+            elif kind == "dactor":
+                if rec[2] is None:
+                    st["detached"].pop(bytes(rec[1]), None)
+                else:
+                    st["detached"][bytes(rec[1])] = rec[2]
+            elif kind == "pg":
+                if rec[2] is None:
+                    st["pgs"].pop(bytes(rec[1]), None)
+                else:
+                    st["pgs"][bytes(rec[1])] = tuple(rec[2])
+            elif kind == "seal":
+                st["sealed"].add(bytes(rec[1]))
+            elif kind == "loc=":
+                locs = {bytes(x) for x in rec[2]}
+                if locs:
+                    st["locs"][bytes(rec[1])] = locs
+                else:
+                    st["locs"].pop(bytes(rec[1]), None)
+            elif kind == "spill":
+                if rec[2] is None:
+                    st["spilled"].pop(bytes(rec[1]), None)
+                else:
+                    st["spilled"][bytes(rec[1])] = tuple(rec[2])
+            elif kind == "lineage":
+                if rec[2] is None:
+                    st["lineage"].pop(bytes(rec[1]), None)
+                else:
+                    st["lineage"][bytes(rec[1])] = rec[2]
+            elif kind == "obj-":
+                oid = bytes(rec[1])
+                st["locs"].pop(oid, None)
+                st["spilled"].pop(oid, None)
+                st["sealed"].discard(oid)
+            elif kind == "head":
+                old_heads.add(bytes(rec[1]))
+            elif kind == "boot":
+                pass  # incarnation breadcrumb (head_meta.json is authoritative)
+            else:
+                raise ValueError(f"unknown WAL record kind {kind!r}")
+
+    def _materialize_restored(self, st: dict, old_heads: set, n_records: int):
+        # the CURRENT head id is not "old" even if a prior boot WAL'd it:
+        # a restarted head reuses its predecessor's identity (head_meta)
+        old_heads = {h for h in old_heads if h != self.head_node_id}
         self.kv.update(st["kv"])
         self.jobs.update(st["jobs"])
         for wire in st["detached"].values():
@@ -827,6 +1041,12 @@ class HeadServer:
             )
             for oid in spec.return_object_ids():
                 self._object_entry(oid)
+            if self._recovery is not None:
+                # live-recovery: the actor's worker may still be ALIVE and
+                # mid-redial — park the creation; a worker re-attach claims
+                # it, and _finish_recovery requeues the unclaimed rest
+                self._recovery["unclaimed_actors"].add(bytes(spec.actor_id))
+                continue
             # old worker processes died with the previous head; re-run the
             # creation task on a fresh worker (actor restart semantics)
             entry = TaskEntry(spec, -1)
@@ -837,11 +1057,14 @@ class HeadServer:
                 self.pgs[pg_id] = PlacementGroupInfo(pg_id, bundles, strategy, name)
         for oid, locs in st["locs"].items():
             # nodes re-register with their prior ids; stale entries for
-            # nodes that never come back are skipped by the pull path.
-            # Entries on a PRIOR head incarnation are gone for good (the
-            # new head created a fresh store segment): drop them so the
-            # wait path falls through to spill-restore / lineage.
+            # nodes that never come back are pruned at the end of the
+            # recovery grace window (or skipped by the pull path).
+            # Entries on a PRIOR head incarnation are gone for good (that
+            # head's store segment was recreated); entries on THIS head's
+            # own node survive when the segment was attached, not rebuilt.
             locs = {n for n in locs if n not in old_heads}
+            if not self._store_preserved:
+                locs.discard(self.head_node_id)
             if locs:
                 self.object_locations[oid] = set(locs)
         for oid, (nid, spath) in st["spilled"].items():
@@ -877,7 +1100,7 @@ class HeadServer:
             len(st["locs"]),
             len(st["spilled"]),
             len(st["lineage"]),
-            len(records),
+            n_records,
         )
         # fold everything into a fresh base so the next restart replays a
         # short WAL
@@ -919,6 +1142,481 @@ class HeadServer:
             except Exception:
                 logger.exception("GCS compaction failed")
 
+    # ------------------------------------- head FT: recovery + reattachment
+
+    def _note_done(self, tid: bytes):
+        """Remember a processed TASK_DONE (bounded) so a reattached
+        worker's replay of the same completion is dropped, not re-applied."""
+        tid = bytes(tid)
+        if tid in self._recent_dones:
+            return
+        if len(self._recent_dones_fifo) == self._recent_dones_fifo.maxlen:
+            self._recent_dones.discard(self._recent_dones_fifo[0])
+        self._recent_dones_fifo.append(tid)
+        self._recent_dones.add(tid)
+
+    def _resubmit_is_duplicate(self, spec: TaskSpec) -> bool:
+        """Idempotent resubmit check: the task id IS the idempotency key.
+        A resubmitted spec is a duplicate if the task is still tracked
+        (re-announced by its reattached worker), was already seen
+        completing, or every return object already sealed/errored (the
+        WAL'd commit point)."""
+        if spec.task_id in self.tasks:
+            return True
+        if bytes(spec.task_id) in self._recent_dones:
+            return True
+        oids = spec.return_object_ids()
+        if oids and all(
+            self.objects.get(oid, (PENDING,))[0] in (SEALED, ERRORED)
+            for oid in oids
+        ):
+            return True
+        return False
+
+    async def _recovery_window(self):
+        rec = self._recovery
+        if rec is None:
+            return
+        await asyncio.sleep(max(0.0, rec["deadline"] - time.time()))
+        try:
+            await self._finish_recovery()
+        except Exception:  # noqa: BLE001
+            logger.exception("recovery reconciliation failed; resuming dispatch anyway")
+            self._recovery = None
+            self._kick_scheduler()
+
+    async def _finish_recovery(self):
+        """Close the grace window: everything re-announced stays; state
+        not reconfirmed is declared dead through the EXISTING machinery —
+        detached-actor creations requeue (fault FSM), unclaimed driver
+        actors die like their owner exited, stale object locations prune
+        so lineage/spill recovery applies, parked calls and resubmits
+        flow with idempotent dedupe."""
+        rec, self._recovery = self._recovery, None
+        if rec is None:
+            return
+        reaped = {"actors": 0, "owners": 0, "locations": 0, "spills": 0}
+        # 1. restored detached actors nobody reclaimed: their workers are
+        #    gone — re-run creation on a fresh worker (cold-restart path)
+        for aid in rec["unclaimed_actors"]:
+            actor = self.actors.get(aid)
+            if actor is None or actor.state != ACTOR_PENDING or actor.worker_id:
+                continue
+            entry = TaskEntry(actor.creation_spec, -1)
+            self.tasks[actor.creation_spec.task_id] = entry
+            self.task_queue.append(entry)
+            reaped["actors"] += 1
+            self._record_event(
+                "WARNING",
+                "head",
+                "ghost reaped: detached actor never re-announced; "
+                "respawning through the restart FSM",
+                actor_id=aid.hex(),
+            )
+        # 2. worker-announced non-detached actors whose owner driver never
+        #    re-attached: same fate as an owner that exited.  Per-actor
+        #    isolation: one malformed entry must not abandon the parked
+        #    resubmit/call drains below (their senders were acked
+        #    {parked: true} and will never re-send)
+        for actor in list(self.actors.values()):
+            if actor.owner_conn_id == -2 and not actor.detached:
+                claim = self._owner_claims.get(actor.actor_id)
+                if claim is not None:
+                    actor.owner_conn_id = claim  # late claim application
+                    continue
+                reaped["owners"] += 1
+                self._owner_claims.pop(actor.actor_id, None)
+                try:
+                    await self._destroy_actor(
+                        actor, "owner driver never re-attached after head restart"
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.exception("orphan-owner reap failed; continuing reconcile")
+        # surviving claims are KEPT: a worker whose redial outlasts the
+        # grace window still binds its announced actors to the right
+        # owner conn instead of the -2 sentinel (which nothing ever reaps)
+        # 3. object locations / spill entries on nodes that never came
+        #    back: prune so gets fall through to spill-restore / lineage
+        #    reconstruction instead of hanging on a dead copy
+        for oid, locs in list(self.object_locations.items()):
+            dead = {n for n in locs if n not in self.nodes}
+            if dead:
+                locs -= dead
+                reaped["locations"] += 1
+                if not locs:
+                    del self.object_locations[oid]
+                self._wal_locs(oid)
+        for oid, (nid, _path) in list(self.object_spilled.items()):
+            if bytes(nid) not in self.nodes:
+                del self.object_spilled[oid]
+                self._wal("spill", bytes(oid), None)
+                reaped["spills"] += 1
+        # 4. actor calls that raced the reconciliation: their actors are
+        #    either re-announced (push) or truly dead (typed error)
+        calls, self._recovery_actor_calls = self._recovery_actor_calls, []
+        for spec in calls:
+            try:
+                await self._submit_actor_task(spec)
+            except Exception:  # noqa: BLE001
+                logger.exception("parked actor call failed during reconcile")
+        # 5. lease restores for still-absent workers stay parked in
+        #    _pending_lease_restores — each worker's own (possibly late)
+        #    reattach drains its entries
+        # 6. parked resubmits: enqueue only what no surviving peer owns
+        resubs, self._recovery_resubmits = self._recovery_resubmits, []
+        deduped = 0
+        for cid, wire in resubs:
+            try:
+                spec = TaskSpec.from_wire(wire)
+                if self._resubmit_is_duplicate(spec):
+                    deduped += 1
+                    continue
+                await self.h_submit_task(cid, None, {"spec": wire})
+            except Exception:  # noqa: BLE001
+                logger.exception("parked resubmit failed during reconcile")
+        duration = time.time() - rec["started"]
+        self.last_recovery = {
+            "at": time.time(),
+            "duration_s": duration,
+            "incarnation": self.incarnation,
+            "reattached": dict(self._reattach_stats),
+            "reaped": reaped,
+            "resubmits": {"received": len(resubs), "deduped": deduped},
+        }
+        self._set_gauge(
+            "ray_tpu_head_recovery_seconds",
+            "duration of the last head recovery grace window",
+            {},
+            duration,
+        )
+        self._record_event(
+            "INFO",
+            "head",
+            "recovery reconcile complete: "
+            f"{self._reattach_stats['nodes']} nodes / "
+            f"{self._reattach_stats['workers']} workers / "
+            f"{self._reattach_stats['drivers']} drivers re-attached, "
+            f"{self._reattach_stats['actors']} actors + "
+            f"{self._reattach_stats['tasks']} running tasks reclaimed; "
+            f"reaped {reaped['actors']} actors, {reaped['owners']} orphaned "
+            f"owners, {reaped['locations']} stale locations; "
+            f"{deduped}/{len(resubs)} resubmits deduped",
+            **{f"reattached_{k}": v for k, v in self._reattach_stats.items()},
+        )
+        logger.info("head recovery complete in %.2fs: %s", duration, self.last_recovery)
+        self._kick_scheduler()
+
+    def _restore_lease(self, cid: int, l: dict):
+        """Re-establish a holder-announced worker lease after a restart.
+        The lease's task flow never stopped (pushes ride the holder↔worker
+        direct conn) — this only restores the head's resource hold so the
+        scheduler doesn't double-book the leased worker."""
+        wid = bytes(l.get("worker_id") or b"")
+        w = self.workers.get(wid)
+        if w is None:
+            # the leased worker is still mid-redial: park the claim; the
+            # worker's own reattach drains it (silently dropping it would
+            # let the scheduler double-book the worker the holder is
+            # still pushing lease tasks to)
+            self._pending_lease_restores.setdefault(wid, []).append((cid, l))
+            return
+        if w.lease is not None:
+            # already held (duplicate announce, or a same-head reattach of
+            # a lease the head never forgot): REBIND it to the holder's new
+            # conn, or the old conn's late EOF would release a lease the
+            # reattached holder is still pushing on
+            old_cid = w.lease.get("cid")
+            if old_cid != cid:
+                lid = bytes(w.lease.get("lease_id") or b"")
+                w.lease["cid"] = cid
+                if old_cid is not None:
+                    self._leases_by_conn.get(old_cid, set()).discard(lid)
+                self._leases_by_conn.setdefault(cid, set()).add(lid)
+            return
+        res = {str(k): float(v) for k, v in (l.get("resources") or {}).items()}
+        node = self.nodes.get(w.node_id)
+        if node is None:
+            return
+        node.acquire(res)
+        node.mark_busy(w)
+        lid = bytes(l.get("lease_id") or b"")
+        w.lease = {
+            "lease_id": lid,
+            "cid": cid,
+            "resources": res,
+            "priority": int(l.get("priority", 1)),
+            "via": "head",
+            "granted_at": time.time(),
+            "revoking": False,
+        }
+        self.leases[lid] = wid
+        self._leases_by_conn.setdefault(cid, set()).add(lid)
+        self._reattach_stats["leases"] += 1
+
+    async def h_reattach(self, cid, conn, p):
+        """A live peer redialed after a head restart and re-announces what
+        it holds.  Role-tagged; every branch is idempotent (a retried
+        reattach re-applies cleanly)."""
+        role = str(p.get("role", ""))
+        if role == "node":
+            nid = bytes(p["node_id"])
+            node = self.nodes.get(nid)
+            if node is None:
+                node = NodeInfo(
+                    nid, conn, p["resources"], p["store_path"], sched=self.sched
+                )
+                self.nodes[nid] = node
+            else:
+                node.conn = conn
+                node.alive = True
+            node.address = p.get("address", "")
+            node.transfer_addr = p.get("transfer_addr", "")
+            if p.get("metrics_addr"):
+                node.labels["metrics_addr"] = p["metrics_addr"]
+            if p.get("dispatch_addr"):
+                node.labels["dispatch_addr"] = p["dispatch_addr"]
+            self._conn_kind[cid] = "raylet"
+            self._conn_node[cid] = nid
+            self._last_beat[cid] = time.time()
+            self._reattach_stats["nodes"] += 1
+            self._record_event(
+                "INFO",
+                "head",
+                "node re-attached after head restart",
+                node_id=nid.hex(),
+                objects=int(p.get("num_objects", 0)),
+            )
+            self._kick_scheduler()
+            return {
+                "ok": True,
+                "head_node_id": self.head_node_id,
+                "incarnation": self.incarnation,
+            }
+        if role == "worker":
+            nid = bytes(p["node_id"])
+            node = self.nodes.get(nid)
+            if node is None:
+                # its raylet hasn't re-registered yet: ask the worker to
+                # retry within its window instead of failing it
+                return {"ok": False, "retry": True, "reason": "node not re-attached yet"}
+            wid = bytes(p["worker_id"])
+            w = self.workers.get(wid)
+            if w is None:
+                w = WorkerInfo(
+                    wid, nid, conn, int(p.get("pid", 0)), has_tpu=bool(p.get("has_tpu"))
+                )
+                self.workers[wid] = w
+                node.workers[wid] = w
+            else:
+                w.conn = conn
+            if p.get("direct_addr"):
+                host = str(node.transfer_addr or "127.0.0.1:0").rsplit(":", 1)[0]
+                port = str(p["direct_addr"]).rsplit(":", 1)[-1]
+                w.direct_addr = f"{host or '127.0.0.1'}:{port}"
+            self._conn_kind[cid] = "worker"
+            self._conn_worker[cid] = wid
+            self._last_beat[cid] = time.time()
+            actor_wire = p.get("actor")
+            if actor_wire:
+                await self._reclaim_actor(w, node, actor_wire, p)
+            elif w.actor_id is None and not w.running_tasks:
+                node.mark_idle(w)
+            for wire in p.get("running", []):
+                spec = TaskSpec.from_wire(wire)
+                existing = self.tasks.get(spec.task_id)
+                if existing is not None:
+                    if existing.state == "QUEUED":
+                        # _on_worker_dead requeued it when this worker's
+                        # old conn EOF'd, but the worker survived and is
+                        # STILL running it: cancel the duplicate retry or
+                        # the scheduler double-executes the task
+                        try:
+                            self.task_queue.remove(existing)
+                        except ValueError:
+                            pass
+                        self.tasks.pop(spec.task_id, None)
+                    else:
+                        continue
+                entry = TaskEntry(spec, -1)
+                entry.state = "RUNNING"
+                entry.worker_id = wid
+                entry.node_id = nid
+                self.tasks[spec.task_id] = entry
+                w.running_tasks.add(spec.task_id)
+                for oid in spec.return_object_ids():
+                    self._object_entry(oid)
+                if spec.task_type == NORMAL_TASK:
+                    node.mark_busy(w)
+                    node.acquire(self._task_resources(spec))
+                elif spec.task_type == ACTOR_CREATION_TASK:
+                    # the crash raced this creation mid-__init__: the dead
+                    # head acked CREATE_ACTOR (so the driver will not
+                    # re-issue it) but the instance wasn't up yet, so the
+                    # worker's announce carries only the running spec.
+                    # Materialize the FSM entry NOW or the imminent
+                    # TASK_DONE has no ActorInfo to flip ALIVE and the
+                    # live actor would be unreachable forever.
+                    aid2 = bytes(spec.actor_id)
+                    actor2 = self.actors.get(aid2)
+                    if actor2 is None:
+                        actor2 = ActorInfo(spec)
+                        actor2.owner_conn_id = (
+                            -1
+                            if spec.detached
+                            else self._owner_claims.get(aid2, -2)
+                        )
+                        self.actors[aid2] = actor2
+                        if spec.name:
+                            self.named_actors[(spec.namespace, spec.name)] = aid2
+                        if spec.detached:
+                            self._wal("dactor", aid2, wire)
+                            self._mark_tables_dirty()
+                        # creation-time hold (implicit CPU included):
+                        # _release_creation_cpu gives the implicit share
+                        # back when TASK_DONE flips it ALIVE
+                        node.acquire(dict(spec.resources or {"CPU": 1.0}))
+                    actor2.worker_id = wid
+                    actor2.node_id = nid
+                    w.dedicated = True
+                    w.actor_id = aid2
+                    node.mark_busy(w)
+                    if self._recovery is not None:
+                        self._recovery["unclaimed_actors"].discard(aid2)
+                self._reattach_stats["tasks"] += 1
+            # a worker-hosted actor can OWN actors (the serve controller
+            # owns its replicas) and hold cached leases, exactly like a
+            # driver — its claims must land or reconciliation owner-reaps
+            # otherwise-healthy actors
+            self._apply_reattach_claims(cid, p)
+            # lease claims parked while THIS worker was mid-redial drain
+            # now (holder conn must still be live — a dead holder's
+            # release path already ran and would never reclaim the hold)
+            for hcid, l in self._pending_lease_restores.pop(wid, []):
+                if hcid in self._conns:
+                    self._restore_lease(hcid, l)
+            self._reattach_stats["workers"] += 1
+            self._kick_scheduler()
+            return {
+                "ok": True,
+                "store_path": node.store_path,
+                # False only for head-node peers when the surviving segment
+                # was unusable and recreated: their mmaps point at the dead
+                # inode and must re-attach (split-brain otherwise)
+                "store_preserved": bool(
+                    self._store_preserved or nid != self.head_node_id
+                ),
+                "shard_addrs": self.shard_addrs,
+                "incarnation": self.incarnation,
+            }
+        if role == "driver":
+            self._conn_kind[cid] = "driver"
+            job_id = p.get("job_id", b"")
+            if job_id not in self.jobs:
+                self.jobs[job_id] = {
+                    "started_at": time.time(),
+                    "driver_pid": p.get("pid", 0),
+                }
+                self._wal("job", job_id, self.jobs[job_id])
+            self._worker_env.update(p.get("worker_env") or {})
+            self._apply_reattach_claims(cid, p)
+            self._reattach_stats["drivers"] += 1
+            return {
+                "ok": True,
+                "store_path": self.nodes[self.head_node_id].store_path,
+                "store_preserved": self._store_preserved,
+                "node_id": self.head_node_id,
+                "shard_addrs": self.shard_addrs,
+                "incarnation": self.incarnation,
+            }
+        raise ValueError(f"unknown reattach role {role!r}")
+
+    def _apply_reattach_claims(self, cid: int, p: dict):
+        """Bind a reattached peer's ownership claims + held leases: claims
+        rebind known actors to the new conn immediately and are retained
+        (_owner_claims) for actors whose hosting worker announces later."""
+        for aid in p.get("owned_actors", []):
+            aid = bytes(aid)
+            self._owner_claims[aid] = cid
+            actor = self.actors.get(aid)
+            if actor is not None and not actor.detached:
+                actor.owner_conn_id = cid
+        for l in p.get("leases", []):
+            self._restore_lease(cid, l)
+
+    async def _reclaim_actor(self, w: WorkerInfo, node: NodeInfo, wire, p: dict):
+        """A surviving actor worker re-announced its actor: rebind it into
+        the directory as ALIVE with its resources re-acquired, whatever
+        the replayed WAL believed."""
+        spec = TaskSpec.from_wire(wire)
+        aid = bytes(spec.actor_id)
+        actor = self.actors.get(aid)
+        # the restart FSM may have queued this actor's re-creation before
+        # the surviving worker's announce landed (same-head conn sever:
+        # _on_worker_dead fired on the old conn's EOF).  A queued creation
+        # is cancelled — the live instance wins; one already RUNNING on a
+        # fresh worker means the FSM owns the actor now, so the stale
+        # instance must NOT be rebound over it.
+        creation = self.tasks.get(spec.task_id)
+        if creation is not None and creation.spec.task_type == ACTOR_CREATION_TASK:
+            if creation.state == "RUNNING" and creation.worker_id != w.worker_id:
+                return
+            if creation.state == "QUEUED":
+                try:
+                    self.task_queue.remove(creation)
+                except ValueError:
+                    pass
+                self.tasks.pop(spec.task_id, None)
+        fresh = actor is None
+        if fresh:
+            actor = ActorInfo(spec)
+            # -2 = awaiting owner reclaim: a driver reattach claims it
+            # (possibly already did — _owner_claims), _finish_recovery
+            # destroys the unclaimed rest (owner-exited semantics).
+            # Detached actors are cluster-owned as usual.
+            if spec.detached:
+                actor.owner_conn_id = -1
+            else:
+                actor.owner_conn_id = self._owner_claims.get(aid, -2)
+            self.actors[aid] = actor
+            if spec.detached:
+                self._wal("dactor", aid, wire)
+                self._mark_tables_dirty()
+        already_bound = actor.worker_id == w.worker_id and actor.state == ACTOR_ALIVE
+        actor.state = ACTOR_ALIVE
+        actor.worker_id = w.worker_id
+        actor.node_id = node.node_id
+        if spec.name:
+            self.named_actors[(spec.namespace, spec.name)] = aid
+        if p.get("actor_direct_addr"):
+            host = str(node.transfer_addr or "127.0.0.1:0").rsplit(":", 1)[0]
+            port = str(p["actor_direct_addr"]).rsplit(":", 1)[-1]
+            actor.direct_addr = f"{host or '127.0.0.1'}:{port}"
+        w.actor_id = aid
+        w.dedicated = True
+        node.mark_busy(w)
+        if not already_bound:
+            # lifetime resources were released with the old head's tables;
+            # the worker genuinely holds them — force-reacquire
+            node.acquire(self._actor_lifetime_resources(spec))
+            actor.creation_cpu_released = True
+            self._reattach_stats["actors"] += 1
+        if self._recovery is not None:
+            self._recovery["unclaimed_actors"].discard(aid)
+        self._actor_mirror.upsert(
+            aid,
+            state=ACTOR_ALIVE,
+            name=spec.name,
+            namespace=spec.namespace,
+            creation_spec=wire,
+            direct_addr=actor.direct_addr,
+            death_cause="",
+        )
+        await self._publish("actor", {"actor_id": aid, "state": ACTOR_ALIVE})
+        # calls queued while the actor was thought PENDING flush now
+        calls, actor.pending_calls = actor.pending_calls, []
+        for call in calls:
+            await self._push_actor_task(actor, call)
+
     # ----------------------------------------------------------- connections
 
     async def _on_connection(self, reader, writer):
@@ -941,7 +1639,7 @@ class HeadServer:
             self._conns.pop(cid, None)
             self._last_beat.pop(cid, None)
             conn.close()
-            await self._on_disconnect(cid)
+            await self._on_disconnect(cid, conn)
 
     async def _handle(self, cid: int, conn: Connection, msg_type: int, rid: int, payload: dict):
         try:
@@ -959,30 +1657,84 @@ class HeadServer:
                 except Exception:  # graftlint: disable=silent-except -- error already logged above; the reply transport itself is dead
                     pass
 
-    async def _on_disconnect(self, cid: int):
+    async def _on_disconnect(self, cid: int, conn: Optional[Connection] = None):
         # leases die with the connection that holds them (driver exit, or
-        # a worker whose nested submits cached leases)
+        # a worker whose nested submits cached leases) — unless the holder
+        # already reattached and the lease was restored under its NEW cid
         for lid in self._leases_by_conn.pop(cid, set()):
             wid = self.leases.get(lid)
             w = self.workers.get(wid) if wid else None
-            if w is not None and w.lease is not None:
+            if (
+                w is not None
+                and w.lease is not None
+                and w.lease.get("cid", cid) == cid
+            ):
                 self._release_lease(
                     w, self.nodes.get(w.node_id), reason="holder disconnected"
                 )
         kind = self._conn_kind.pop(cid, None)
+        # ownership claims recorded for this conn die with it: a LATER
+        # "late claim application" must never rebind an actor to a
+        # vanished conn id (conn ids are not reused — that actor would
+        # leak forever)
+        if kind in ("worker", "driver"):
+            for aid in [a for a, c in self._owner_claims.items() if c == cid]:
+                del self._owner_claims[aid]
         if kind == "worker":
             wid = self._conn_worker.pop(cid, None)
+            w = self.workers.get(wid) if wid else None
+            if w is not None and conn is not None and w.conn is not conn:
+                return  # reattached on a newer conn: this EOF is stale
             if wid:
                 await self._on_worker_dead(wid, "worker process died (connection lost)")
         elif kind == "raylet":
             nid = self._conn_node.pop(cid, None)
+            node = self.nodes.get(nid) if nid else None
+            if node is not None and conn is not None and node.conn is not conn:
+                return  # node reattached on a newer conn: stale EOF
             if nid:
                 await self._on_node_dead(nid)
         elif kind == "driver":
-            # non-detached actors owned by this driver die with it
-            for actor in list(self.actors.values()):
-                if actor.owner_conn_id == cid and not actor.detached:
+            # non-detached actors owned by this driver die with it — but
+            # with a reconnect window open the driver may be mid-redial
+            # (same-head conn sever): park the orphans behind the window
+            # and reap only those never re-claimed
+            orphans = [
+                actor
+                for actor in self.actors.values()
+                if actor.owner_conn_id == cid and not actor.detached
+            ]
+            if not orphans:
+                return
+            window = RayConfig.head_reconnect_window_s
+            if window <= 0:
+                for actor in orphans:
                     await self._destroy_actor(actor, "owner driver exited")
+                return
+            ids = []
+            for actor in orphans:
+                actor.owner_conn_id = -2  # awaiting owner re-claim
+                ids.append(actor.actor_id)
+            asyncio.get_running_loop().create_task(
+                self._reap_unclaimed_owners(ids, window + 1.0)
+            )
+
+    async def _reap_unclaimed_owners(self, actor_ids: List[bytes], delay: float):
+        """Reattach-window grace for owner death: destroy only the actors
+        whose owner never re-claimed them (reattach rebinds owner_conn_id
+        via _apply_reattach_claims, which also records _owner_claims)."""
+        await asyncio.sleep(delay)
+        for aid in actor_ids:
+            actor = self.actors.get(bytes(aid))
+            if actor is None or actor.detached or actor.owner_conn_id != -2:
+                continue
+            claim = self._owner_claims.get(bytes(aid))
+            if claim is not None:
+                actor.owner_conn_id = claim  # late claim application
+                continue
+            await self._destroy_actor(
+                actor, "owner driver exited (never re-attached)"
+            )
 
     # ------------------------------------------------------ lifecycle: nodes
 
@@ -1066,6 +1818,8 @@ class HeadServer:
         window = period * RayConfig.num_heartbeats_timeout
         while not self._shutdown:
             await asyncio.sleep(period)
+            if self._recovery is not None:
+                continue  # grace window: peers are mid-redial, not dead
             now = time.time()
             for cid, kind in list(self._conn_kind.items()):
                 if kind not in ("raylet", "worker"):
@@ -1077,6 +1831,22 @@ class HeadServer:
                 if now - last <= window:
                     continue
                 conn = self._conns.get(cid)
+                # a peer that REATTACHed on a newer conn leaves this cid's
+                # mappings stale until the old socket EOFs: drop them
+                # without reaping the (live, beating-elsewhere) peer
+                peer = (
+                    self.nodes.get(self._conn_node.get(cid, b""))
+                    if kind == "raylet"
+                    else self.workers.get(self._conn_worker.get(cid, b""))
+                )
+                if peer is not None and peer.conn is not conn:
+                    self._conn_kind.pop(cid, None)
+                    self._conn_node.pop(cid, None)
+                    self._conn_worker.pop(cid, None)
+                    self._last_beat.pop(cid, None)
+                    if conn is not None:
+                        conn.close()
+                    continue
                 if kind == "raylet":
                     nid = self._conn_node.get(cid)
                     logger.warning(
@@ -1879,7 +2649,25 @@ class HeadServer:
             self._release_contained(bytes(oid))
         return {"ok": True}
 
+    def _ref_batch_seen(self, p) -> bool:
+        """Dedupe re-sent ref flushes (head-FT: a conn loss may race the
+        reply, so clients re-send tagged batches after reattach — counter
+        bumps are not idempotent on their own)."""
+        b = p.get("batch")
+        if not b:
+            return False
+        b = bytes(b)
+        if b in self._ref_batches:
+            return True
+        if len(self._ref_batches_fifo) == self._ref_batches_fifo.maxlen:
+            self._ref_batches.discard(self._ref_batches_fifo[0])
+        self._ref_batches_fifo.append(b)
+        self._ref_batches.add(b)
+        return False
+
     async def h_add_ref(self, cid, conn, p):
+        if self._ref_batch_seen(p):
+            return {"ok": True, "deduped": True}
         for oid in p["object_ids"]:
             self.object_refcounts[oid] = self.object_refcounts.get(oid, 0) + 1
         return {"ok": True}
@@ -1903,6 +2691,16 @@ class HeadServer:
         return ids
 
     def _dec_ref(self, oid: bytes):
+        if (
+            self._refs_amnesic
+            and oid not in self.object_refcounts
+            and oid in self.objects
+        ):
+            # restarted head: this object's pre-crash client refs were
+            # never re-announced — the count is UNKNOWN, not zero.  Keep
+            # the object (leaks until job teardown) rather than deleting
+            # data another peer still references.
+            return
         n = self.object_refcounts.get(oid, 0) - 1
         if n <= 0:
             self.object_refcounts.pop(oid, None)
@@ -1989,6 +2787,8 @@ class HeadServer:
         return None
 
     async def h_remove_ref(self, cid, conn, p):
+        if self._ref_batch_seen(p):
+            return {"ok": True, "deduped": True}
         for oid in p["object_ids"]:
             self._dec_ref(oid)
         return {"ok": True}
@@ -2005,6 +2805,17 @@ class HeadServer:
 
     async def h_submit_task(self, cid, conn, p):
         spec = TaskSpec.from_wire(p["spec"])
+        if p.get("resubmit"):
+            # post-reattach resubmission of an unacked submit: the task id
+            # is the idempotency key — a submit that raced the crash must
+            # never double-execute.  During the grace window the verdict
+            # can't be final yet (its worker may still be mid-redial), so
+            # the spec parks until reconciliation closes.
+            if self._recovery is not None:
+                self._recovery_resubmits.append((cid, p["spec"]))
+                return {"ok": True, "parked": True}
+            if self._resubmit_is_duplicate(spec):
+                return {"ok": True, "deduped": True}
         # flight recorder: the phases dict is SHARED with p["spec"] (the
         # cached wire reused for PUSH_TASK), so this stamp reaches the
         # worker too.  None when the submitting driver has recording off —
@@ -2041,6 +2852,11 @@ class HeadServer:
     async def _submit_actor_task(self, spec: TaskSpec):
         actor = self.actors.get(spec.actor_id)
         if actor is None:
+            if self._recovery is not None:
+                # the actor's worker may be mid-redial: park the call;
+                # _finish_recovery re-runs it once the directory settles
+                self._recovery_actor_calls.append(spec)
+                return {"ok": True, "parked": True}
             self._unpin_args(spec)
             await self._seal_error_objects(spec, "RayActorError: unknown actor")
             return {"ok": False}
@@ -2080,6 +2896,12 @@ class HeadServer:
 
     async def h_task_done(self, cid, conn, p):
         tid = p["task_id"]
+        if p.get("replay"):
+            # a reattached worker re-sends its recent completions (it
+            # can't know which landed before the crash): apply at most once
+            if bytes(tid) in self._recent_dones:
+                return {"ok": True, "deduped": True}
+        self._note_done(tid)
         wid = self._conn_worker.get(cid)
         w = self.workers.get(wid) if wid else None
         if wid is not None and w is None:
@@ -2454,6 +3276,13 @@ class HeadServer:
 
     async def h_create_actor(self, cid, conn, p):
         spec = TaskSpec.from_wire(p["spec"])
+        existing = self.actors.get(spec.actor_id)
+        if existing is not None and existing.state != ACTOR_DEAD:
+            # idempotent retry: a driver whose CREATE_ACTOR reply was lost
+            # to a head crash re-issues it after reattach — the actor id
+            # is the dedupe key, creation must not run twice
+            existing.owner_conn_id = cid if not existing.detached else existing.owner_conn_id
+            return {"ok": True, "existing": True}
         if spec.name:
             key = (spec.namespace, spec.name)
             if key in self.named_actors:
@@ -2557,6 +3386,12 @@ class HeadServer:
     # ------------------------------------------------------ placement groups
 
     async def h_create_pg(self, cid, conn, p):
+        existing = self.pgs.get(bytes(p["pg_id"]))
+        if existing is not None and existing.state != "REMOVED":
+            # idempotent retry (head-FT parked path): a creator whose reply
+            # was lost to a head crash re-issues CREATE_PG after reattach —
+            # re-placing would double-reserve the bundles
+            return {"ok": True, "placed": existing.state == "CREATED", "existing": True}
         pg = PlacementGroupInfo(p["pg_id"], p["bundles"], p["strategy"], p.get("name", ""))
         self.pgs[pg.pg_id] = pg
         self._wal("pg", bytes(pg.pg_id), (pg.bundles, pg.strategy, pg.name))
@@ -2960,6 +3795,15 @@ class HeadServer:
             return self._summary_slo()
         if what == "preemptions":
             return self._summary_preemptions(limit)
+        if what == "head":
+            return {
+                "incarnation": self.incarnation,
+                "head_node_id": self.head_node_id.hex(),
+                "started_at": self.started_at,
+                "restarts_total": self.incarnation - 1,
+                "recovering": self._recovery is not None,
+                "last_recovery": self.last_recovery,
+            }
         if what != "tasks":
             raise ValueError(f"unknown summary kind {what!r}")
         records = list(self.task_records)
@@ -3828,6 +4672,12 @@ class HeadServer:
                 pass
 
     async def _schedule_once(self):
+        if self._recovery is not None:
+            # recovery grace window: dispatch holds while live peers
+            # re-attach — placing work on half-reconciled capacity could
+            # double-book workers whose running tasks haven't been
+            # re-announced yet (gcs/HEAD_FT.md)
+            return
         # retry pending PGs (e.g. after resources freed / node added)
         for pg in self.pgs.values():
             if pg.state in ("PENDING", "RESCHEDULING"):
@@ -5000,4 +5850,5 @@ HeadServer._HANDLERS = {
     MsgType.TASK_STATS: HeadServer.h_task_stats,
     MsgType.PROFILE_CTRL: HeadServer.h_profile_ctrl,
     MsgType.PROFILE_STATS: HeadServer.h_profile_stats,
+    MsgType.REATTACH: HeadServer.h_reattach,
 }

@@ -30,6 +30,16 @@ from ray_tpu._private import chaos
 logger = logging.getLogger(__name__)
 
 
+class WalCorruptionError(Exception):
+    """A WAL record is corrupt in the MIDDLE of a log (valid records
+    follow it).  Unlike a torn tail — the expected shape of a crash mid-
+    append, where truncating at the tear recovers every acknowledged
+    record before it — skipping a mid-file record and applying later ones
+    would replay mutations out of order (a kv delete before its put, a
+    location update before the seal it follows).  The caller must fall
+    back to snapshot-only recovery, loudly."""
+
+
 class GcsSnapshotStorage:
     """Atomic write-then-rename snapshot of the GCS tables."""
 
@@ -164,30 +174,64 @@ class GcsWalStorage:
 
     @classmethod
     def _replay_file(cls, path: str, records: List[Tuple]):
+        """Replay one log file.  Corruption is treated POSITIONALLY:
+
+        - a corrupt record at the very END of the file (short header/
+          payload, or a crc mismatch with nothing after it) is a torn
+          tail — the expected crash-mid-append shape.  The file is
+          TRUNCATED at the tear (so later appends can never land behind
+          garbage that replay would stop at) and the prefix is kept.
+        - a corrupt record with valid bytes AFTER it is mid-file
+          corruption: raising ``WalCorruptionError`` forces snapshot-only
+          recovery instead of replaying a reordered suffix.
+        """
         if not os.path.exists(path):
             return
+        trunc_at = None
         with open(path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
             while True:
+                start = f.tell()
                 hdr = f.read(cls._HDR.size)
+                if not hdr:
+                    break
                 if len(hdr) < cls._HDR.size:
+                    trunc_at = start  # torn header write at EOF
                     break
                 length, crc = cls._HDR.unpack(hdr)
                 payload = f.read(length)
-                if len(payload) < length or zlib.crc32(payload) != crc:
-                    break  # torn tail: stop at the last whole record
-                try:
-                    records.append(pickle.loads(payload))
-                except Exception:  # noqa: BLE001
-                    logger.warning(
-                        "undecodable WAL record in %s after %d records; "
-                        "stopping replay here",
-                        path,
-                        len(records),
-                        exc_info=True,
-                    )
+                bad = len(payload) < length or zlib.crc32(payload) != crc
+                decoded = None
+                if not bad:
+                    try:
+                        decoded = pickle.loads(payload)
+                    except Exception:  # graftlint: disable=silent-except -- undecodable == corrupt record; handled positionally below (truncate tail / raise WalCorruptionError)
+                        bad = True  # crc-valid but undecodable: corrupt
+                if bad:
+                    if len(payload) == length and f.tell() < size:
+                        raise WalCorruptionError(
+                            f"{path}: corrupt record at offset {start} with "
+                            f"{size - f.tell()} bytes following it — mid-file "
+                            "corruption, refusing partial replay"
+                        )
+                    trunc_at = start
                     break
+                records.append(decoded)
+        if trunc_at is not None:
+            logger.warning(
+                "%s: torn tail record at offset %d truncated; %d records "
+                "recovered before it",
+                path,
+                trunc_at,
+                len(records),
+            )
+            with open(path, "r+b") as f:
+                f.truncate(trunc_at)
 
     def load(self) -> Tuple[Optional[Dict[str, Any]], List[Tuple]]:
+        """Restore (base tables, WAL records).  Raises WalCorruptionError
+        on mid-file corruption — the caller decides whether to fall back
+        to snapshot-only recovery (``self.base.load()``)."""
         tables = self.base.load()
         records: List[Tuple] = []
         self._replay_file(self.rotated_path, records)
@@ -232,9 +276,34 @@ class GcsWalStorage:
     def finish_compact(self, snapshot: bytes):
         """Phase 2 (safe OFF the loop — touches only the base file and the
         rotated segment, which the appender never writes): make the
-        snapshot durable, then drop the folded-in WAL segment."""
+        snapshot durable, then drop the folded-in WAL segment.
+
+        Atomicity contract (chaos point ``disk.wal.compact``): any failure
+        before the ``os.replace`` leaves the OLD base + the rotated
+        segment intact, so a restart replays exactly the pre-compaction
+        state; the rotated segment is only unlinked AFTER the new base is
+        durable."""
         tmp = self.base.path + ".tmp"
         with open(tmp, "wb") as f:
+            if chaos.disk_on:
+                verdict = chaos.disk_decide("disk.wal.compact")
+                if verdict is not None:
+                    action, param = verdict
+                    if action == "delay":
+                        time.sleep(param)  # slow snapshot write (off-loop)
+                    elif action == "short":
+                        # torn snapshot write: half the bytes reach the tmp
+                        # file, then ENOSPC — the tmp is abandoned, never
+                        # renamed over the base
+                        f.write(snapshot[: len(snapshot) // 2])
+                        f.flush()
+                        raise OSError(
+                            errno.ENOSPC, "chaos: short compaction write"
+                        )
+                    elif action == "fail":
+                        raise OSError(
+                            errno.ENOSPC, "chaos: compaction write failed"
+                        )
             f.write(snapshot)
             f.flush()
             os.fsync(f.fileno())

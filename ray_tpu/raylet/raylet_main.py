@@ -159,18 +159,18 @@ class Raylet:
         self.conn = conn
         reply_fut = asyncio.get_running_loop().create_task(self._read_loop(conn))
         asyncio.get_running_loop().create_task(self._heartbeat_loop(conn))
-        reply = await conn.request(
-            MsgType.REGISTER_NODE,
-            {
-                "node_id": self.node_id.binary(),
-                "resources": self.resources,
-                "store_path": self.store_path,
-                "address": advertise,
-                "transfer_addr": f"{advertise}:{transfer_port}",
-                "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
-                "dispatch_addr": dispatch_addr,
-            },
-        )
+        # announce payload is also the head-FT reattach announce (plus
+        # role/num_objects): keep it for the redial loop
+        self._announce = {
+            "node_id": self.node_id.binary(),
+            "resources": self.resources,
+            "store_path": self.store_path,
+            "address": advertise,
+            "transfer_addr": f"{advertise}:{transfer_port}",
+            "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
+            "dispatch_addr": dispatch_addr,
+        }
+        reply = await conn.request(MsgType.REGISTER_NODE, self._announce)
         if not reply.get("ok"):
             raise RuntimeError(
                 f"head rejected node registration for {self.node_id.hex()[:8]}: "
@@ -184,8 +184,9 @@ class Raylet:
         loop = asyncio.get_running_loop()
 
         def _publish_logs(msg: dict):
+            # via self.conn: survives a head-FT conn swap after a restart
             asyncio.run_coroutine_threadsafe(
-                conn.send(
+                self.conn.send(
                     MsgType.PUBLISH, {"channel": "logs", "message": msg}
                 ),
                 loop,
@@ -203,7 +204,7 @@ class Raylet:
             # RECORD_EVENT frames are exempt from injection)
             def _chaos_emit(ev: dict):
                 asyncio.run_coroutine_threadsafe(
-                    conn.send(
+                    self.conn.send(
                         MsgType.RECORD_EVENT,
                         {
                             "severity": "WARNING",
@@ -235,7 +236,7 @@ class Raylet:
             # PUBLISH branch of _read_loop
             def _profile_emit(payload: dict):
                 asyncio.run_coroutine_threadsafe(
-                    conn.send(
+                    self.conn.send(
                         MsgType.PROFILE_STATS,
                         dict(payload, node_id=self.node_id.binary()),
                     ),
@@ -260,7 +261,85 @@ class Raylet:
                     file=sys.stderr,
                 )
         print(f"NODE {self.node_id.hex()}", flush=True)
-        await reply_fut
+        # service loop: the read loop ending means the head conn died.
+        # With a redial window configured this node RIDES THROUGH a head
+        # restart — local workers, the store, and the lease agent keep
+        # serving while we reattach — instead of tearing the node down.
+        while True:
+            try:
+                await reply_fut
+            except Exception:  # noqa: BLE001
+                # unexpected read-loop failure (IO errors are caught inside
+                # it): fall through to a clean teardown, never skip
+                # shutdown() — workers and the store die with this node
+                traceback.print_exc(file=sys.stderr)
+                break
+            window = RayConfig.head_reconnect_window_s
+            if window <= 0:
+                break
+            got = await self._redial_head(window)
+            if got is None:
+                break
+            self.conn, reply_fut = got
+            asyncio.get_running_loop().create_task(self._heartbeat_loop(self.conn))
+            print("raylet: reattached to restarted head", file=sys.stderr, flush=True)
+        self.shutdown()
+
+    async def _redial_head(self, window: float):
+        """Redial + REATTACH within the window.  Returns (conn, read_fut)
+        or None when the head never came back."""
+        import time
+
+        from ray_tpu._private.chaos import Backoff
+
+        print(
+            f"raylet: head connection lost; redialing for up to {window:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        deadline = time.monotonic() + window
+        backoff = Backoff(base=0.1, cap=1.0)
+        loop = asyncio.get_running_loop()
+        while time.monotonic() < deadline:
+            rem = deadline - time.monotonic()
+            try:
+                conn = await Connection.connect(
+                    self.head_host, self.head_port, min(max(rem, 0.1), 5.0), retry=False
+                )
+            except Exception:  # graftlint: disable=silent-except -- head still down; the redial loop IS the handler (backoff below, typed give-up at the window)
+                await asyncio.sleep(
+                    min(backoff.next_delay_or(1.0), max(0.05, deadline - time.monotonic()))
+                )
+                continue
+            read_fut = loop.create_task(self._read_loop(conn))
+            payload = dict(self._announce)
+            payload["role"] = "node"
+            try:
+                payload["num_objects"] = self.store.num_objects()
+            except OSError:
+                payload["num_objects"] = 0
+            try:
+                reply = await conn.request(MsgType.REATTACH, payload, 10)
+                if not reply.get("ok"):
+                    raise ConnectionError(f"head rejected node reattach: {reply!r}")
+            except Exception:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+                conn.close()
+                try:
+                    await read_fut
+                except Exception:  # graftlint: disable=silent-except -- read loop on an abandoned dial; its conn is already closed
+                    pass
+                await asyncio.sleep(
+                    min(backoff.next_delay_or(1.0), max(0.05, deadline - time.monotonic()))
+                )
+                continue
+            return conn, read_fut
+        print(
+            f"raylet: head still unreachable after {window:.1f}s; shutting down node",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
 
     async def _heartbeat_loop(self, conn: Connection):
         """Periodic liveness beacon.  The head declares this node dead after
@@ -351,8 +430,8 @@ class Raylet:
                     profiler.apply_ctrl(payload.get("message") or {})
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
-        finally:
-            self.shutdown()
+        # shutdown is decided by run()'s service loop: with a reconnect
+        # window open, a dead head conn means redial, not teardown
 
     async def _handle_pull(self, conn: Connection, rid: int, payload: dict):
         oid = bytes(payload["object_id"])

@@ -230,6 +230,40 @@ def cmd_summary(args):
                 )
         _print_engine_gauges(reply.get("serve_engine", {}))
         return 0
+    if args.what == "head":
+        print(
+            f"== head == incarnation={reply.get('incarnation')} "
+            f"restarts={reply.get('restarts_total')} "
+            f"node={str(reply.get('head_node_id', ''))[:12]} "
+            f"recovering={reply.get('recovering')}"
+        )
+        lr = reply.get("last_recovery")
+        if lr:
+            att = lr.get("reattached", {})
+            reaped = lr.get("reaped", {})
+            resub = lr.get("resubmits", {})
+            print(
+                f"  last recovery: {lr.get('duration_s', 0):.2f}s at "
+                f"{time.strftime('%H:%M:%S', time.localtime(lr.get('at', 0)))} "
+                f"(incarnation {lr.get('incarnation')})"
+            )
+            print(
+                f"  reattached: {att.get('nodes', 0)} nodes, "
+                f"{att.get('workers', 0)} workers, {att.get('drivers', 0)} "
+                f"drivers, {att.get('actors', 0)} actors, "
+                f"{att.get('tasks', 0)} running tasks, "
+                f"{att.get('leases', 0)} leases"
+            )
+            print(
+                f"  reaped: {reaped.get('actors', 0)} actors, "
+                f"{reaped.get('owners', 0)} orphaned owners, "
+                f"{reaped.get('locations', 0)} stale locations, "
+                f"{reaped.get('spills', 0)} stale spills; resubmits "
+                f"{resub.get('deduped', 0)}/{resub.get('received', 0)} deduped"
+            )
+        else:
+            print("  no recovery this incarnation")
+        return 0
     if args.what == "preemptions":
         counts = reply.get("counts", {})
         print(
@@ -437,7 +471,7 @@ def main():
 
     p = sub.add_parser("summary", help="workload summaries from the flight recorder")
     p.add_argument(
-        "what", choices=["tasks", "serve", "train", "memory", "preemptions"]
+        "what", choices=["tasks", "serve", "train", "memory", "preemptions", "head"]
     )
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)

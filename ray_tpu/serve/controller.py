@@ -10,8 +10,11 @@ on reported in-flight load.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class Replica:
@@ -186,6 +189,61 @@ class ServeController:
         self.deployments: Dict[str, dict] = {}
         self.version = 0
         self._recover()
+        # head fault tolerance: after this worker's CoreWorker reattaches
+        # to a restarted head, re-sync replica state — probe every
+        # replica, drop the dead, respawn to target, and re-publish so
+        # handles refresh their (possibly stale) routing tables
+        try:
+            self._core().on_reattach(self._schedule_resync)
+        except Exception:
+            pass  # no runtime yet (unit-test construction): resync is moot
+
+    def _schedule_resync(self):
+        """Runs on the reattach-callback thread: route the resync through
+        our OWN actor handle so it serializes with deploy/scale on the
+        actor executor instead of mutating deployment state from a
+        foreign thread mid-rolling-replace."""
+        import ray_tpu
+        from ray_tpu.serve.api import CONTROLLER_NAME
+
+        try:
+            me = ray_tpu.get_actor(CONTROLLER_NAME)
+            me.resync_after_head_restart.remote()
+        except Exception:  # noqa: BLE001
+            logger.exception("post-restart serve resync could not be scheduled")
+
+    def resync_after_head_restart(self):
+        import ray_tpu
+
+        changed = False
+        for name, dep in list(self.deployments.items()):
+            probes = [(r, r.stats.remote()) for r in list(dep["replicas"])]
+            dead = []
+            for r, ref in probes:
+                try:
+                    ray_tpu.get(ref, timeout=30)
+                except Exception:
+                    dead.append(r)
+            for r in dead:
+                try:
+                    idx = dep["replicas"].index(r)
+                except ValueError:
+                    continue
+                dep["replicas"].pop(idx)
+                gone = dep["replica_names"].pop(idx)
+                dep.get("replica_nodes", {}).pop(gone, None)
+                changed = True
+            before = len(dep["replicas"])
+            self._reconcile(name)
+            changed = changed or len(dep["replicas"]) != before
+        # always republish: handles may hold replica handles whose actor
+        # entries the restarted head reaped — a version bump makes them
+        # re-pull instead of erroring against ghosts
+        self.version += 1
+        self._checkpoint()
+        for name in self.deployments:
+            self._publish_update(name)
+        return changed
 
     # -------------------------------------------------- checkpoint/recover
 

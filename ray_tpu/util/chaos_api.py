@@ -106,16 +106,24 @@ def _strike_event(message: str, **fields):
     cw = _core_worker()
     if cw is None:
         return
+    payload = {
+        "severity": "WARNING",
+        "source": "chaos",
+        "message": message,
+        "fields": fields,
+    }
+
+    # fire-and-forget: a strike against the HEAD itself (kill_head, or a
+    # worker kill while the head is mid-restart) must not park the caller
+    # on the head-FT reconnect window for bookkeeping
+    async def _send():
+        try:
+            await cw.conn.send(MsgType.RECORD_EVENT, payload)
+        except (ConnectionError, OSError):
+            pass  # head gone; the strike itself already landed
+
     try:
-        cw.request(
-            MsgType.RECORD_EVENT,
-            {
-                "severity": "WARNING",
-                "source": "chaos",
-                "message": message,
-                "fields": fields,
-            },
-        )
+        cw.io.spawn(_send())
     except Exception:  # graftlint: disable=silent-except -- strike bookkeeping is best-effort; the strike itself already landed
         pass
 

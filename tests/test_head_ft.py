@@ -1,0 +1,594 @@
+"""Head fault tolerance (gcs/HEAD_FT.md): a head SIGKILL + restart is a
+recoverable event for the whole live cluster.
+
+Covers the WAL's positional corruption semantics (torn tail vs mid-file),
+compaction atomicity under injected faults, live-cluster reconnect +
+reconciliation (workers/actors/running tasks survive the restart in
+place), driver-visible parking/idempotency contracts, and the sustained
+seeded-chaos gate: head killed and auto-restarted mid serve+train+data
+with zero lost steps and exactly-once task results.
+
+Reference analog: GCS fault tolerance against Redis-backed storage +
+HandleNotifyGCSRestart (reference: src/ray/gcs/gcs_server/ +
+node_manager.cc:1161).
+
+The multi-second live-cluster restart cases are marked slow so tier-1
+keeps only the fast WAL/semantics checks; the dedicated head-ft CI job
+(`pytest -m head_ft`) runs everything, slow included."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.config import RayConfig
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import HeadUnreachableError
+
+pytestmark = pytest.mark.head_ft
+
+
+# ============================================================ WAL semantics
+
+
+def test_wal_torn_tail_truncates_and_recovers_prefix(tmp_path):
+    """A torn FINAL record (crash mid-append) is the expected shape:
+    replay keeps every record before the tear and physically truncates
+    the file so later appends never land behind garbage."""
+    from ray_tpu.gcs.storage import GcsWalStorage
+
+    st = GcsWalStorage(str(tmp_path))
+    st.append(("kv", "a", b"1"))
+    st.append(("kv", "b", b"2"))
+    st.sync()
+    clean_size = os.path.getsize(st.wal_path)
+    with open(st.wal_path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00")  # torn header+partial payload at EOF
+        f.write(b"garbage")
+
+    st2 = GcsWalStorage(str(tmp_path))
+    tables, records = st2.load()
+    assert records == [("kv", "a", b"1"), ("kv", "b", b"2")]
+    assert os.path.getsize(st2.wal_path) == clean_size  # tear truncated
+    # appends after recovery extend the clean prefix
+    st2.append(("kv", "c", b"3"))
+    st2.sync()
+    _, records = GcsWalStorage(str(tmp_path)).load()
+    assert records == [("kv", "a", b"1"), ("kv", "b", b"2"), ("kv", "c", b"3")]
+
+
+def test_wal_midfile_corruption_fails_to_snapshot_only(tmp_path):
+    """A corrupt record with valid records AFTER it is mid-file
+    corruption: skipping it would replay a reordered suffix (e.g. a kv
+    delete before its put) — load() must refuse, and the head must fall
+    back to snapshot-only recovery, loudly."""
+    from ray_tpu.gcs.storage import GcsWalStorage, WalCorruptionError
+
+    st = GcsWalStorage(str(tmp_path))
+    st.append(("kv", "a", b"1"))
+    mid_start = st.wal_bytes
+    st.append(("kv", "b", b"2"))
+    mid_end = st.wal_bytes
+    st.append(("kv", "c", b"3"))
+    st.sync()
+
+    # flip payload bytes INSIDE the middle record (header intact)
+    with open(st.wal_path, "r+b") as f:
+        f.seek(mid_start + 8 + 2)  # past the u32 len + u32 crc header
+        f.write(b"\xff\xff")
+    assert mid_end < os.path.getsize(st.wal_path)
+
+    with pytest.raises(WalCorruptionError):
+        GcsWalStorage(str(tmp_path)).load()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("action", ["fail", "short"])
+def test_wal_compaction_fault_keeps_consistent_state(tmp_path, action):
+    """Chaos at the compaction rewrite point (phase-2 fold): ENOSPC or a
+    torn snapshot write must leave the OLD base + the rotated segment
+    intact, so a restart replays exactly the pre-compaction state."""
+    from ray_tpu.gcs.storage import GcsWalStorage
+
+    st = GcsWalStorage(str(tmp_path))
+    st.append(("kv", "a", b"1"))
+    st.append(("kv", "b", b"2"))
+    st.sync()
+    chaos.arm(f"disk.wal.compact.{action}#1=1.0", seed=3)
+    try:
+        with pytest.raises(OSError):
+            st.compact({"kv": {"a": b"1", "b": b"2"}, "head_node_id": b""})
+    finally:
+        chaos.disarm()
+    # restart: base unchanged (None), records all replay from the
+    # rotated segment the failed compaction left behind
+    st2 = GcsWalStorage(str(tmp_path))
+    tables, records = st2.load()
+    assert tables is None
+    assert records == [("kv", "a", b"1"), ("kv", "b", b"2")]
+    # a later healthy compaction folds cleanly and drops the segment
+    st2.compact({"kv": {"a": b"1", "b": b"2"}, "head_node_id": b""})
+    assert not os.path.exists(st2.rotated_path)
+    tables, records = GcsWalStorage(str(tmp_path)).load()
+    assert tables["kv"] == {"a": b"1", "b": b"2"} and records == []
+
+
+# ===================================================== live-cluster restart
+
+
+def _set_ft_env(monkeypatch, window="25", grace="2.0"):
+    monkeypatch.setenv("RAY_TPU_HEAD_RECONNECT_WINDOW_S", window)
+    monkeypatch.setenv("RAY_TPU_HEAD_RECOVERY_GRACE_S", grace)
+    RayConfig.reset()
+
+
+@pytest.fixture
+def ft_cluster(monkeypatch):
+    """A cluster whose head, workers, and this driver all run with a head
+    reconnect window open (env is inherited by every spawned process)."""
+    _set_ft_env(monkeypatch)
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    yield c
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    c.shutdown()
+    RayConfig.reset()
+
+
+def _restart_after(cluster, delay, args=None):
+    t = threading.Timer(
+        delay, lambda: cluster.restart_head(args or {"num_cpus": 4})
+    )
+    t.start()
+    return t
+
+
+@ray_tpu.remote
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self):
+        self.n += 1
+        return self.n
+
+    def total(self):
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+
+@pytest.mark.slow
+def test_live_actor_rides_through_head_restart(ft_cluster):
+    """The payoff: a live actor keeps serving direct calls THROUGH the
+    outage, survives in the same process, and the restarted head
+    re-learns it from the worker's reattach announce."""
+    ray_tpu.init(address=ft_cluster.address)
+    c = _Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=120) == 1
+    pid_before = ray_tpu.get(c.pid.remote(), timeout=60)
+
+    ft_cluster.kill_head()
+    t = _restart_after(ft_cluster, 1.0)
+    # direct actor calls are head-free: they flow during the outage
+    for want in range(2, 6):
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == want
+    t.join()
+
+    # a head-path RPC works again post-reattach, against the SAME actor
+    # process — state survived, no respawn
+    assert ray_tpu.get(c.pid.remote(), timeout=120) == pid_before
+    from ray_tpu.experimental.state.api import summarize_workloads
+
+    deadline = time.time() + 30
+    head = summarize_workloads("head")
+    assert head["incarnation"] == 2
+    while head.get("recovering") or not head.get("last_recovery"):
+        assert time.time() < deadline, f"recovery never concluded: {head}"
+        time.sleep(0.5)
+        head = summarize_workloads("head")
+    assert head["last_recovery"]["reattached"]["workers"] >= 1
+    assert head["last_recovery"]["reattached"]["actors"] >= 1
+    # restart + reconcile are on the operator timeline
+    from ray_tpu.util.chaos_api import _core_worker
+    from ray_tpu._private.protocol import MsgType
+
+    events = _core_worker().request(MsgType.LIST_EVENTS, {})["events"]
+    msgs = [e["message"] for e in events if e.get("source") == "head"]
+    assert any("head restarted" in m for m in msgs)
+    assert any("recovery reconcile complete" in m for m in msgs)
+
+
+@pytest.mark.slow
+def test_get_parked_across_restart_returns_value(ft_cluster):
+    """A ray_tpu.get blocked on a head-path task parks across the outage
+    and returns the right value: the worker keeps executing, its
+    TASK_DONE replays on reattach, the parked WAIT re-issues."""
+    ray_tpu.init(
+        address=ft_cluster.address,
+        _system_config={"lease_cache_enabled": False},
+    )
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(4.0)
+        return x * 3
+
+    ref = slow.remote(5)
+    time.sleep(1.5)  # let it dispatch to a worker
+    ft_cluster.kill_head()
+    t = _restart_after(ft_cluster, 1.0)
+    assert ray_tpu.get(ref, timeout=120) == 15
+    t.join()
+
+
+@pytest.mark.slow
+def test_idempotent_resubmit_never_double_executes(ft_cluster):
+    """Tasks in flight when the head dies are resubmitted after reattach
+    with their task id as idempotency key: every task lands EXACTLY once
+    (counter-actor assertion), whether it was queued at the dead head,
+    running on a surviving worker, or already sealed."""
+    ray_tpu.init(
+        address=ft_cluster.address,
+        _system_config={"lease_cache_enabled": False},
+    )
+    counter = _Counter.remote()
+    assert ray_tpu.get(counter.incr.remote(), timeout=120) == 1
+
+    @ray_tpu.remote
+    def bump(h, i):
+        ray_tpu.get(h.incr.remote())
+        return i
+
+    n = 12
+    refs = [bump.remote(counter, i) for i in range(n)]
+    time.sleep(0.5)  # a mix: some dispatched, some queued at the head
+    ft_cluster.kill_head()
+    t = _restart_after(ft_cluster, 1.0)
+    assert ray_tpu.get(refs, timeout=180) == list(range(n))
+    t.join()
+    # exactly once: the warm-up incr plus ONE per task, no double runs
+    assert ray_tpu.get(counter.total.remote(), timeout=60) == n + 1
+
+
+def test_driver_past_window_gets_typed_error(monkeypatch):
+    """A head that never comes back fails the driver TYPED once the
+    reconnect window closes — parked, then HeadUnreachableError."""
+    _set_ft_env(monkeypatch, window="2")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        from ray_tpu._private.worker import global_worker
+
+        cw = global_worker.core_worker
+        assert cw.kv_put("k", b"v")
+        c.kill_head()
+        start = time.time()
+        with pytest.raises(HeadUnreachableError):
+            cw.kv_get("k")
+        # parked for roughly the window, then typed — not an instant
+        # crash, not a forever hang
+        assert time.time() - start < 30
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+        RayConfig.reset()
+
+
+def test_window_zero_preserves_fail_fast(monkeypatch):
+    """head_reconnect_window_s=0 (the default) keeps today's semantics:
+    a lost head conn fails fast with a typed HeadUnreachableError."""
+    monkeypatch.delenv("RAY_TPU_HEAD_RECONNECT_WINDOW_S", raising=False)
+    RayConfig.reset()
+    assert RayConfig.head_reconnect_window_s == 0.0
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+        from ray_tpu._private.worker import global_worker
+
+        cw = global_worker.core_worker
+        assert cw.kv_put("k", b"v")
+        c.kill_head()
+        start = time.time()
+        with pytest.raises((HeadUnreachableError, ConnectionError)):
+            for _ in range(100):  # first call may race the loss detection
+                cw.kv_get("k")
+                time.sleep(0.05)
+        assert time.time() - start < 20
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+        RayConfig.reset()
+
+
+@pytest.mark.slow
+def test_detached_ghost_reaped_through_restart_fsm(ft_cluster):
+    """A detached actor whose worker dies DURING the outage cannot
+    re-announce: the grace window must reap it through the existing
+    restart machinery — it comes back ALIVE in a fresh process."""
+    from ray_tpu.util import chaos_api
+
+    ray_tpu.init(address=ft_cluster.address)
+    ghost = _Counter.options(
+        name="ghost", lifetime="detached", max_restarts=4
+    ).remote()
+    assert ray_tpu.get(ghost.incr.remote(), timeout=120) == 1
+    old_pid = ray_tpu.get(ghost.pid.remote(), timeout=60)
+
+    ft_cluster.kill_head()
+    chaos_api.kill_worker(pid=old_pid)  # dies while the head is down
+    t = _restart_after(ft_cluster, 1.0)
+    t.join()
+    new_pid = chaos_api.wait_actor_respawn(ghost, old_pid, timeout=120)
+    assert new_pid != old_pid
+    # fresh incarnation: state reset by the respawn (detached restart
+    # semantics, not preemption restore)
+    assert ray_tpu.get(ghost.incr.remote(), timeout=60) == 1
+
+
+@pytest.mark.slow
+def test_raylet_rides_through_head_restart(ft_cluster):
+    """A separate raylet NODE survives the head restart: it redials,
+    re-announces with role=node, its hosted actor keeps serving direct
+    calls through the outage, and fresh node-resource work places on the
+    reattached node afterwards."""
+    node = ft_cluster.add_node(num_cpus=2, resources={"side": 2.0})
+    ray_tpu.init(address=ft_cluster.address)
+
+    side_counter = _Counter.options(resources={"side": 1.0}).remote()
+    assert ray_tpu.get(side_counter.incr.remote(), timeout=120) == 1
+
+    ft_cluster.kill_head()
+    t = _restart_after(ft_cluster, 1.0)
+    # cross-node direct calls flow during the outage
+    assert ray_tpu.get(side_counter.incr.remote(), timeout=60) == 2
+    t.join()
+    assert ray_tpu.get(side_counter.incr.remote(), timeout=120) == 3
+
+    from ray_tpu.experimental.state.api import summarize_workloads
+
+    deadline = time.time() + 40
+    head = summarize_workloads("head")
+    while head.get("recovering") or not head.get("last_recovery"):
+        assert time.time() < deadline, f"recovery never concluded: {head}"
+        time.sleep(0.5)
+        head = summarize_workloads("head")
+    assert head["last_recovery"]["reattached"]["nodes"] >= 1, (
+        f"raylet never reattached: {head['last_recovery']}"
+    )
+    assert node.proc.poll() is None, "raylet tore itself down"
+
+    # the reattached node's resources still place fresh work
+    @ray_tpu.remote(resources={"side": 1.0})
+    def on_side():
+        return "ok"
+
+    assert ray_tpu.get(on_side.remote(), timeout=120) == "ok"
+
+
+# ========================================================== THE chaos gate
+
+
+@pytest.mark.slow
+def test_sustained_head_kill_chaos_gate(monkeypatch):
+    """THE gate: serve + resident-DAG train + data run concurrently; the
+    head is SIGKILLed (chaos strike) and supervised-restarted mid-load.
+
+    Asserts — not just observes — that:
+      * the resident train gang keeps stepping THROUGH the outage (zero
+        lost steps, every step value exact) — the compiled-DAG channel
+        path is head-free;
+      * checkpoint traffic (head KV) stalls during the outage and
+        RESUMES after reattach;
+      * serve keeps answering (direct path) and its post-recovery p99
+        holds the declared SLO;
+      * every data task returns its correct value exactly once (counter
+        assertion across the lease and head paths);
+      * the restarted head reconciled the live cluster (summary +
+        events), and seeded wire chaos really fired during the window.
+    """
+    SERVE_P99_S = 1.5
+    _set_ft_env(monkeypatch, window="30", grace="2.5")
+    monkeypatch.setenv("RAY_TPU_CHAOS_ENABLE", "1")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    try:
+        _run_head_kill_gate(c, SERVE_P99_S)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+        RayConfig.reset()
+
+
+def _run_head_kill_gate(cluster, serve_p99_s):
+    from ray_tpu import serve
+    from ray_tpu.experimental.state.api import summarize_workloads
+    from ray_tpu.util import chaos_api, slo_api
+
+    ray_tpu.init(address=cluster.address)
+    slo_api.set_slos(
+        [
+            {
+                "name": "serve_p99_ms",
+                "metric": "ray_tpu_serve_request_seconds",
+                "tags": {"stage": "serve_e2e"},
+                "quantile": 0.99,
+                "threshold_ms": serve_p99_s * 1e3,
+                "window_s": 300,
+            }
+        ]
+    )
+
+    # --- serve plane
+    @serve.deployment
+    def echo(x):
+        return x * 2
+
+    handle = serve.run(echo.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=120) == 2  # warm: direct path
+
+    # --- seeded wire chaos for the whole window (deterministic)
+    chaos_api.arm("worker:wire.send.delay@TASK_DONE=0.2:0.02", seed=13)
+
+    # --- train plane: a resident compiled DAG "gang" (the substrate
+    # train/jax/step_dag.py runs on) — one channel write per step,
+    # head-free once armed
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, mult):
+            self.mult = mult
+            self.steps = 0
+
+        def step(self, x):
+            self.steps += 1
+            return x * self.mult
+
+    from ray_tpu.dag import InputNode
+
+    s1, s2 = Stage.remote(3), Stage.remote(7)
+    with InputNode() as inp:
+        dag = s2.step.bind(s1.step.bind(inp))
+    gang = dag.compile()
+
+    # --- data plane: counter-backed exactly-once assertion
+    counter = _Counter.remote()
+    assert ray_tpu.get(counter.incr.remote(), timeout=120) == 1
+
+    @ray_tpu.remote
+    def shard(h, i):
+        ray_tpu.get(h.incr.remote())
+        return i * 10
+
+    data_refs = {}
+    train_log = []
+    ckpt_log = []
+    ckpt_stall = {}
+    stop = threading.Event()
+
+    def ckpt_loop():
+        """Checkpoint/metrics traffic: head-path KV writes.  Stalls
+        during the outage (parked), resumes after reattach."""
+        from ray_tpu._private.worker import global_worker
+
+        cw = global_worker.core_worker
+        i = 0
+        while not stop.is_set():
+            t0 = time.time()
+            try:
+                cw.kv_put("gate:ckpt", str(len(train_log)).encode())
+                ckpt_log.append(time.time())
+                dt = time.time() - t0
+                ckpt_stall["max"] = max(ckpt_stall.get("max", 0.0), dt)
+            except Exception as e:  # noqa: BLE001
+                ckpt_stall["error"] = repr(e)
+            i += 1
+            time.sleep(0.2)
+
+    ck = threading.Thread(target=ckpt_loop, daemon=True)
+    ck.start()
+
+    serve_lat_post = []
+
+    def drive(seconds, expect_serve=True, serve_lat=None, data=True):
+        end = time.time() + seconds
+        i = len(data_refs)
+        while time.time() < end:
+            # train: the gang steps through EVERYTHING; exact values
+            x = len(train_log) + 1
+            assert gang.execute(x, timeout=60) == x * 21
+            train_log.append(x)
+            if expect_serve:
+                t0 = time.time()
+                assert ray_tpu.get(handle.remote(7), timeout=60) == 14
+                if serve_lat is not None:
+                    serve_lat.append(time.time() - t0)
+            if data:
+                ref = shard.remote(counter, i)
+                data_refs[ref] = i * 10
+                i += 1
+            time.sleep(0.02)
+
+    # phase 1: healthy mixed load
+    drive(6.0)
+    steps_before_kill = len(train_log)
+    assert steps_before_kill >= 20
+
+    # phase 2: SIGKILL the head (chaos strike) + supervised auto-restart
+    chaos_api.kill_head(cluster)
+    sup = _restart_after(cluster, 2.0)
+    # through the outage: the gang keeps stepping, serve keeps answering
+    # on its warm direct path, data tasks keep flowing on cached leases
+    drive(6.0)
+    sup.join()
+    assert len(train_log) > steps_before_kill + 10, "gang stalled during the outage"
+
+    # phase 3: recovered — wait out the grace window, then assert the
+    # world is whole
+    deadline = time.time() + 60
+    head = summarize_workloads("head")
+    while head.get("recovering") or not head.get("last_recovery"):
+        assert time.time() < deadline, f"recovery never concluded: {head}"
+        time.sleep(0.5)
+        head = summarize_workloads("head")
+    assert head["incarnation"] == 2
+
+    drive(6.0, serve_lat=serve_lat_post)
+    stop.set()
+    ck.join(timeout=10)
+
+    # zero lost steps: every step of the contiguous sequence returned its
+    # exact value (asserted inline); the count is monotone through the kill
+    assert train_log == list(range(1, len(train_log) + 1))
+
+    # checkpoint traffic stalled (parked > the restart gap) and RESUMED
+    assert "error" not in ckpt_stall, f"checkpoint writer died: {ckpt_stall}"
+    assert ckpt_stall.get("max", 0.0) > 1.0, (
+        f"checkpoint writes never stalled ({ckpt_stall}) — was the head "
+        "really down?"
+    )
+    assert ckpt_log and ckpt_log[-1] > time.time() - 5.0, "ckpt traffic never resumed"
+
+    # every data task: right value, exactly once
+    values = ray_tpu.get(list(data_refs), timeout=180)
+    assert values == [data_refs[r] for r in data_refs]
+    total = ray_tpu.get(counter.total.remote(), timeout=60)
+    assert total == len(data_refs) + 1, (
+        f"counter={total} for {len(data_refs)} tasks: a resubmit "
+        "double-executed (or a task never ran)"
+    )
+
+    # serve recovered to its SLO after the window (client-observed p99)
+    lat = sorted(serve_lat_post)
+    assert len(lat) >= 20, f"post-recovery window too thin: {len(lat)}"
+    p99 = lat[int(0.99 * (len(lat) - 1))]
+    assert p99 <= serve_p99_s, (
+        f"post-recovery serve p99 {p99 * 1e3:.0f}ms blew the "
+        f"{serve_p99_s * 1e3:.0f}ms SLO"
+    )
+    verdicts = {s["name"]: s for s in summarize_workloads("slo").get("slos", [])}
+    serve_slo = verdicts.get("serve_p99_ms")
+    assert serve_slo is not None and serve_slo["samples"] > 0
+
+    # the reconcile happened and is observable
+    lr = head["last_recovery"]
+    assert lr["reattached"]["workers"] >= 1
+    assert lr["reattached"]["drivers"] >= 1
+    # seeded chaos really fired during the window
+    assert chaos_api.fault_events(), "seeded chaos plan never fired"
+    chaos_api.disarm()
+
+    gang.teardown()
