@@ -57,6 +57,7 @@ def ensure_lib(name: str) -> str:
             + spec["sources"]
             + spec["flags"]
         )
+        # graftsan: disable=GS002 -- serializing the one-time native build under _LOCK is the point: every caller needs the finished .so before proceeding
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(f"native build failed: {' '.join(cmd)}\n{proc.stderr}")

@@ -32,6 +32,13 @@ MAX_FRAME = 1 << 31
 
 
 class MsgType(enum.IntEnum):
+    # Retired slots — values burned, never reuse (IntEnum silently aliases
+    # reused values; see the TASK_UNBLOCKED=26 incident below):
+    #   NODE_TABLE=13   (clients read node tables via LIST_NODES)
+    #   PIN_OBJECT=47   (pinning rides ADD_REF / task-spec containment)
+    #   PUBSUB_POLL=57  (subscribers get pushed PUBLISH frames)
+    #   ERROR_PUSH=80   (task errors reach drivers as stored RayTaskError values)
+
     # replies
     REPLY = 0
     ERROR_REPLY = 1
@@ -40,8 +47,7 @@ class MsgType(enum.IntEnum):
     REGISTER_NODE = 10
     REGISTER_WORKER = 11
     HEARTBEAT = 12
-    NODE_TABLE = 13  # graftlint: disable=protocol-exhaustive -- reserved taxonomy slot (reference gcs_service.proto); clients read node tables via LIST_NODES
-    DRAIN_NODE = 14
+    DRAIN_NODE = 14  # graftsan: disable=GS004 -- operator-initiated drain: the head-side handler is the product surface; senders are external admin tooling (ROADMAP autoscaling), not this tree
 
     # tasks (analog: core_worker.proto PushTask, node_manager RequestWorkerLease)
     SUBMIT_TASK = 20
@@ -49,7 +55,7 @@ class MsgType(enum.IntEnum):
     PUSH_TASK = 21
     TASK_DONE = 22
     CANCEL_TASK = 23
-    STEAL_OK = 24  # graftlint: disable=protocol-exhaustive -- reserved for work stealing (reference task stealing protocol); scheduler does not steal yet
+    STEAL_OK = 24  # graftlint: disable=protocol-exhaustive -- reserved for work stealing (reference task stealing protocol); scheduler does not steal yet  # graftsan: disable=GS004 -- reserved: ROADMAP work-stealing lands both sides at once; the slot stays so wire captures stay decodable
     TASK_BLOCKED = 25  # worker blocked in get(): release its cpu (analog:
     TASK_UNBLOCKED = 27  # reference NotifyDirectCallTaskBlocked, raylet_client.cc)
     # NOTE: 26 is taken by SUBMIT_TASKS above.  TASK_UNBLOCKED was
@@ -68,13 +74,12 @@ class MsgType(enum.IntEnum):
 
     # objects (analog: object_manager.proto, core_worker GetObjectStatus)
     PUT_OBJECT = 40
-    GET_OBJECT = 41  # graftlint: disable=protocol-exhaustive -- reserved; gets resolve via WAIT_OBJECT + shared-memory mmap, never a payload RPC
+    GET_OBJECT = 41  # graftlint: disable=protocol-exhaustive -- reserved; gets resolve via WAIT_OBJECT + shared-memory mmap, never a payload RPC  # graftsan: disable=GS004 -- reserved: ROADMAP device-tier object plane needs a payload-get frame; keep the slot
     FREE_OBJECT = 42
-    OBJECT_LOCATION = 43  # graftlint: disable=protocol-exhaustive -- reserved; the head's object directory answers location queries inside WAIT_OBJECT
+    OBJECT_LOCATION = 43  # graftlint: disable=protocol-exhaustive -- reserved; the head's object directory answers location queries inside WAIT_OBJECT  # graftsan: disable=GS004 -- reserved: ROADMAP device-tier object plane will query locations out-of-band; keep the slot
     WAIT_OBJECT = 44
     ADD_REF = 45
     REMOVE_REF = 46
-    PIN_OBJECT = 47  # graftlint: disable=protocol-exhaustive -- reserved; pinning rides ADD_REF / task-spec containment, no dedicated frame yet
     OBJECT_PULL = 48  # head → raylet: pull oid from a peer's transfer agent
     OBJECT_DELETE = 49  # head → raylet: drop local copy (+ spill files)
     SPILL_NOTIFY = 90  # any store claimant → head: these oids now live on disk
@@ -93,7 +98,6 @@ class MsgType(enum.IntEnum):
     KV_EXISTS = 54
     SUBSCRIBE = 55
     PUBLISH = 56
-    PUBSUB_POLL = 57  # graftlint: disable=protocol-exhaustive -- reserved; subscribers get pushed PUBLISH frames, long-poll fallback not implemented
 
     # placement groups (analog: gcs_service.proto PlacementGroupInfoGcsService)
     CREATE_PG = 60
@@ -113,9 +117,6 @@ class MsgType(enum.IntEnum):
     LIST_EVENTS = 77
     RECORD_EVENT = 78  # any process → head: append to the cluster-event ring
     TASK_SUMMARY = 79  # per-phase latency summary over the flight records
-
-    # errors pushed to driver
-    ERROR_PUSH = 80  # graftlint: disable=protocol-exhaustive -- reserved; task errors reach drivers as stored RayTaskError values, not pushed frames
 
     # fault injection (chaos.py): driver → head arm/disarm, fanned out to
     # chaos-aware processes over the "chaos" pubsub channel

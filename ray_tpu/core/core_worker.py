@@ -52,6 +52,7 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     WorkerCrashedError,
 )
+from ray_tpu.util.lockwitness import named_condition, named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -234,9 +235,9 @@ class CoreWorker:
         self.head_host, self.head_port = head_host, head_port
         self.current_task_id: Optional[bytes] = None  # set by the executor
         self._put_counter = 0
-        self._put_lock = threading.Lock()
+        self._put_lock = named_lock("CoreWorker._put_lock")
         self._local_refs: Dict[bytes, int] = {}
-        self._refs_lock = threading.Lock()
+        self._refs_lock = named_lock("CoreWorker._refs_lock")
         self._pending_removals: List[bytes] = []
         self._pending_adds: List[bytes] = []
         self._submit_buffer: List[dict] = []
@@ -252,7 +253,7 @@ class CoreWorker:
         self._direct_pending: Dict[bytes, threading.Event] = {}
         # signalled on every direct completion (wait() blocks here instead
         # of on individual events, which would starve in list order)
-        self._direct_cv = threading.Condition()
+        self._direct_cv = named_condition("CoreWorker._direct_cv")
         self._direct_conns: Dict[bytes, Connection] = {}  # actor_id -> conn
         # oid -> callbacks fired once the object resolves (io-loop context;
         # used by Serve's handle to track in-flight without a thread per
@@ -260,7 +261,7 @@ class CoreWorker:
         # _wake_direct so a resolving direct call can't slip between the
         # resolved-check and the pending-check.
         self._done_callbacks: Dict[bytes, List[Callable[[], None]]] = {}
-        self._cb_lock = threading.Lock()
+        self._cb_lock = named_lock("CoreWorker._cb_lock")
         # task_id -> arg ObjectRef handles held until the reply: the head
         # never sees a direct task, so the CALLER's local refs are what pin
         # the args for the call's duration
@@ -319,7 +320,7 @@ class CoreWorker:
         # (shape, node_affinity, band) -> _LeasePool: once leases for
         # shape S are held, queues of S-shaped tasks push straight to the
         # leased workers — no head round-trip per task
-        self._lease_lock = threading.Lock()
+        self._lease_lock = named_lock("CoreWorker._lease_lock")
         self._leases: Dict[tuple, _LeasePool] = {}
         self._lease_by_id: Dict[bytes, _Lease] = {}
         self._lease_gc_started = False
@@ -2301,6 +2302,7 @@ class CoreWorker:
 
     async def _direct_call(self, conn: Connection, spec: TaskSpec, actor_id: bytes):
         try:
+            # graftsan: disable=GS005 -- actor method runtime is unbounded by design; the bounded failure mode is conn loss (read loop dies -> pending replies fail), not a timer
             reply = await conn.request(
                 MsgType.ACTOR_CALL, {"spec": spec.to_wire()}, timeout=None
             )

@@ -12,6 +12,7 @@ import threading
 from typing import Dict, Optional
 
 from ray_tpu._private.build_native import ensure_lib
+from ray_tpu.util.lockwitness import named_lock
 
 SCALE = 10_000
 MAX_RESOURCES = 128
@@ -62,7 +63,7 @@ class NativeScheduler:
         self._node_ids: Dict[bytes, int] = {}
         self._idx_to_node: Dict[int, bytes] = {}
         self._next_node = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("NativeScheduler._lock")
 
     def _intern(self, name: str) -> int:
         idx = self._names.get(name)

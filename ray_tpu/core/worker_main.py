@@ -28,6 +28,7 @@ from ray_tpu._private import serialization
 from ray_tpu._private.ids import JobID
 from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
 from ray_tpu.exceptions import RayTaskError
+from ray_tpu.util.lockwitness import named_condition, named_lock
 
 
 class _ActorState:
@@ -53,7 +54,7 @@ class WorkerRuntime:
         # mutual exclusion between eager actor calls and compiled-DAG
         # executor steps (ray_tpu/dag/): a sequential actor keeps its
         # one-call-at-a-time contract across both modes
-        self.actor_lock = threading.Lock()
+        self.actor_lock = named_lock("WorkerRuntime.actor_lock")
         # lease fast path (control plane): batched completion frames per
         # holder conn + batched flight records to the head.  Flushing is
         # an io-loop TIMER (~2ms coalescing window), never the run
@@ -61,7 +62,7 @@ class WorkerRuntime:
         # NEXT task blocks in user code or arg resolution — holding it
         # until the queue drains deadlocks consumer tasks waiting on the
         # unflushed result.
-        self._lease_out_lock = threading.Lock()
+        self._lease_out_lock = named_lock("WorkerRuntime._lease_out_lock")
         self._lease_outbox: Dict[int, list] = {}  # id(conn) -> results
         self._lease_conns: Dict[int, Any] = {}
         self._stats_buffer: List[dict] = []
@@ -72,7 +73,7 @@ class WorkerRuntime:
         # still in flight when the checkpoint ships would be requeued by
         # the head and double-executed on the restored state
         self._inflight = 0
-        self._inflight_cv = threading.Condition()
+        self._inflight_cv = named_condition("WorkerRuntime._inflight_cv")
         self._dag_runtime = None  # lazy: ray_tpu.dag.executor.DagWorkerRuntime
         # per-caller sequential ordering across the head→direct transition
         # (reference analog: sequential_actor_submit_queue.cc): seq we expect
@@ -87,7 +88,7 @@ class WorkerRuntime:
         # coroutine snapshots — an unlocked snapshot can raise mid-announce
         # and leave the actor un-re-announced (ghost-reaped while alive)
         self._head_inflight: Dict[bytes, dict] = {}
-        self._head_inflight_lock = threading.Lock()
+        self._head_inflight_lock = named_lock("WorkerRuntime._head_inflight_lock")
 
     # ------------------------------------------------------------ main loop
 

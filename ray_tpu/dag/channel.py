@@ -162,6 +162,20 @@ class ShmRing:
                     f"shm ring full for {timeout:.0f}s: consumer stalled or dead"
                 )
             time.sleep(0.0002)
+        self._publish(seq, blob)
+
+    def write_slot_nowait(self, seq: int, blob: bytes) -> None:
+        """Publish into a slot the caller just confirmed free via
+        ``can_accept(seq)``.  The reader cursor only ever advances, so room
+        cannot vanish between the check and the write — this path never
+        waits, which is what lets the serve engine call it from its io
+        loop (graftsan GS001: ``write_slot`` proper parks in a back-
+        pressure sleep)."""
+        if self._view is None:
+            raise ChannelBrokenError("shm ring closed")
+        self._publish(seq, blob)
+
+    def _publish(self, seq: int, blob: bytes) -> None:
         off = self._slot_off(seq)
         self._LEN.pack_into(self._view, off, len(blob))
         start = off + self._LEN.size
@@ -262,9 +276,9 @@ class ChannelWriter:
                 if not ring.can_accept(seq):
                     return False
                 if ring.fits(len(blob)):
-                    ring.write_slot(seq, blob)
+                    ring.write_slot_nowait(seq, blob)
                     return True
-                ring.write_slot(seq, b"")
+                ring.write_slot_nowait(seq, b"")
         self._send_inline(seq, wire, err)
         return True
 

@@ -50,6 +50,7 @@ from ray_tpu.exceptions import (
     DagInvalidatedError,
     RayActorError,
 )
+from ray_tpu.util.lockwitness import named_lock
 
 
 class _Participant:
@@ -118,9 +119,9 @@ class CompiledDag:
         # guards the small broken/torn-down flags and is NEVER held across
         # blocking channel IO — the io thread's _mark_broken must always
         # get through to wake a reader a collect thread is blocked on
-        self._step_lock = threading.Lock()
-        self._read_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._step_lock = named_lock("CompiledDag._step_lock")
+        self._read_lock = named_lock("CompiledDag._read_lock")
+        self._state_lock = named_lock("CompiledDag._state_lock")
         self._broken: Optional[str] = None
         self._torn_down = False
         self._seq = 0

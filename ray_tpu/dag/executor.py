@@ -43,6 +43,8 @@ from ray_tpu.dag.channel import (
     encode_value,
 )
 from ray_tpu.exceptions import RayTaskError
+from ray_tpu.tools import graftsan
+from ray_tpu.util.lockwitness import named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -74,7 +76,7 @@ class _DagInstance:
         # flight-recorder batching (reference analog: task_event_buffer.cc
         # flushes periodically, never per event): node loops append step
         # records under _ev_lock, one DAG_STEP frame ships a batch
-        self._ev_lock = threading.Lock()
+        self._ev_lock = named_lock("_DagInstance._ev_lock")
         self._ev_buf: List[dict] = []
         self._ev_last_flush = 0.0
 
@@ -254,6 +256,7 @@ class DagWorkerRuntime:
 
     # ----------------------------------------------------------- executor
 
+    @graftsan.loop_root
     def _node_loop(self, dag: _DagInstance, node: _NodeState) -> None:
         """The resident hot loop: block on inputs → run → push.  With task
         events off this stamps nothing — one flag check per step."""
@@ -319,6 +322,10 @@ class DagWorkerRuntime:
                 fut = asyncio.run_coroutine_threadsafe(
                     fn(*args, **kwargs), self._runtime.actor.async_loop
                 )
+                # The node loop is a resident data-plane thread whose step
+                # IS this call: parking on the actor's asyncio loop until
+                # the async method finishes is the execution model.
+                # graftsan: disable=GS001 -- resident step thread blocks on its own async step by design
                 return fut.result(), False
             if not node.lock:
                 # node opted out via bind(...).options(lock=False): it may

@@ -44,31 +44,39 @@ class DashboardServer:
         def _json(data):
             return web.json_response(data)
 
+        async def _off(fn, *args):
+            # every dashboard read is a sync head RPC round trip: run it
+            # off-loop so one wedged head can't stall the whole http loop
+            # for rpc_timeout_s (graftsan GS001)
+            import asyncio as _aio
+
+            return await _aio.get_running_loop().run_in_executor(None, fn, *args)
+
         async def api_cluster(request):
             return _json(
                 {
-                    "resources_total": ray_tpu.cluster_resources(),
-                    "resources_available": ray_tpu.available_resources(),
+                    "resources_total": await _off(ray_tpu.cluster_resources),
+                    "resources_available": await _off(ray_tpu.available_resources),
                 }
             )
 
         async def api_nodes(request):
-            return _json(list_nodes())
+            return _json(await _off(list_nodes))
 
         async def api_actors(request):
-            return _json(list_actors())
+            return _json(await _off(list_actors))
 
         async def api_tasks(request):
-            return _json(list_tasks())
+            return _json(await _off(list_tasks))
 
         async def api_pgs(request):
-            return _json(list_placement_groups())
+            return _json(await _off(list_placement_groups))
 
         async def api_metrics(request):
-            return web.Response(text=metrics_mod.prometheus_text())
+            return web.Response(text=await _off(metrics_mod.prometheus_text))
 
         async def api_timeline(request):
-            return _json(ray_tpu.timeline())
+            return _json(await _off(ray_tpu.timeline))
 
         async def api_task_summary(request):
             """Flight-recorder per-phase latency summary (p50/p95/max per
@@ -118,12 +126,12 @@ class DashboardServer:
         async def api_events(request):
             from ray_tpu.experimental.state.api import list_cluster_events
 
-            return _json(list_cluster_events())
+            return _json(await _off(list_cluster_events))
 
         async def api_objects(request):
             from ray_tpu.experimental.state.api import list_objects
 
-            return _json(list_objects())
+            return _json(await _off(list_objects))
 
         async def api_serve_get(request):
             """Serve application status (reference: the dashboard serve
@@ -131,7 +139,7 @@ class DashboardServer:
             from ray_tpu.serve import schema as serve_schema
 
             try:
-                return _json(serve_schema.status())
+                return _json(await _off(serve_schema.status))
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": str(e)}, status=500)
 
@@ -158,10 +166,10 @@ class DashboardServer:
                 return web.json_response({"error": str(e)}, status=500)
 
         async def index(request):
-            total = ray_tpu.cluster_resources()
-            avail = ray_tpu.available_resources()
-            nodes = list_nodes()
-            actors = list_actors()
+            total = await _off(ray_tpu.cluster_resources)
+            avail = await _off(ray_tpu.available_resources)
+            nodes = await _off(list_nodes)
+            actors = await _off(list_actors)
             rows = "".join(
                 f"<tr><td>{n['node_id'][:12]}</td><td>{'alive' if n['alive'] else 'dead'}</td>"
                 f"<td>{n['num_workers']}</td><td>{json.dumps(n['resources'])}</td></tr>"

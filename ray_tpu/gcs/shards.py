@@ -35,8 +35,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.util.lockwitness import named_lock
 
 logger = logging.getLogger("ray_tpu.gcs.shards")
 
@@ -56,11 +59,11 @@ class ShardedKV:
         n = max(1, int(nshards))
         self._n = n
         self._shards: List[Dict[str, bytes]] = [dict() for _ in range(n)]
-        self._locks = [threading.Lock() for _ in range(n)]
+        self._locks = [named_lock(f"ShardedKV._locks[{i}]") for i in range(n)]
         # key -> [(loop, future)]: kv_get(wait=True) waiters, fired by
         # whichever thread lands the put (on the waiter's own loop)
         self._waiters: Dict[str, List[Tuple[asyncio.AbstractEventLoop, asyncio.Future]]] = {}
-        self._wlock = threading.Lock()
+        self._wlock = named_lock("ShardedKV._wlock")
 
     def _i(self, key: str) -> int:
         return zlib.crc32(key.encode()) % self._n
@@ -177,7 +180,7 @@ class ObjectMirror:
     def __init__(self):
         self._state: Dict[bytes, Tuple[int, Optional[str]]] = {}
         self._waiters: Dict[bytes, List[Tuple[asyncio.AbstractEventLoop, asyncio.Future]]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("ObjectMirror._lock")
 
     def state(self, oid: bytes) -> Tuple[int, Optional[str]]:
         with self._lock:
@@ -237,7 +240,7 @@ class ActorMirror:
     def __init__(self):
         self._actors: Dict[bytes, dict] = {}
         self._named: Dict[Tuple[str, str], bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("ActorMirror._lock")
 
     def upsert(self, actor_id: bytes, **fields):
         with self._lock:

@@ -154,8 +154,12 @@ async def start_metrics_server(
                 body += out or ""
             else:
                 # app metrics live in the cluster KV: only reachable from a
-                # connected process (a bare agent serves node stats only)
-                body += metrics_mod.prometheus_text()
+                # connected process (a bare agent serves node stats only).
+                # Off-loop: the read is a sync RPC to the head, and this
+                # loop may be the head's own RPC loop.
+                body += await asyncio.get_running_loop().run_in_executor(
+                    None, metrics_mod.prometheus_text
+                )
         except Exception:  # graftlint: disable=silent-except -- app-metrics source unavailable (disconnected agent / head mid-restart); node+device stats still serve, by design
             pass
         return web.Response(text=body, content_type="text/plain")

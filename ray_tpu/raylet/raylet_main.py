@@ -27,6 +27,7 @@ from ray_tpu._private import profiler
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.protocol import Connection, MsgType
+from ray_tpu.util.lockwitness import named_lock
 
 
 class Raylet:
@@ -42,7 +43,7 @@ class Raylet:
         self._zygote = None
         # spawns run on executor threads (off the read loop): serialize
         # seq/zygote mutation
-        self._spawn_lock = threading.Lock()
+        self._spawn_lock = named_lock("Raylet._spawn_lock")
         self._worker_seq = 0
         self.store = None
         self.object_agent = None
@@ -170,7 +171,11 @@ class Raylet:
             "metrics_addr": f"{advertise}:{metrics_port}" if metrics_port else "",
             "dispatch_addr": dispatch_addr,
         }
-        reply = await conn.request(MsgType.REGISTER_NODE, self._announce)
+        # bounded like every other request on this conn: a head wedged
+        # mid-recovery must fail the registration, not park the raylet
+        # forever (30s > REATTACH's 10 — first registration can land while
+        # the head is still replaying its WAL)
+        reply = await conn.request(MsgType.REGISTER_NODE, self._announce, 30)
         if not reply.get("ok"):
             raise RuntimeError(
                 f"head rejected node registration for {self.node_id.hex()[:8]}: "

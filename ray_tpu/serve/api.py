@@ -236,7 +236,17 @@ class HTTPProxy:
             if trace is not None:
                 trace["deployment"] = name
             if name not in self._handles:
-                self._handles[name] = DeploymentHandle(name, controller)
+                import asyncio as _aio
+
+                # first touch of a deployment runs a sync SUBSCRIBE RPC in
+                # the handle constructor: build it off-loop so the http
+                # loop keeps serving (graftsan GS001).  setdefault keeps
+                # the winner if two first requests race across the await;
+                # the loser's subscription self-prunes via its weakref.
+                h = await _aio.get_running_loop().run_in_executor(
+                    None, DeploymentHandle, name, controller
+                )
+                self._handles.setdefault(name, h)
             handle = self._handles[name]
             handle.refresh_if_stale()
             try:

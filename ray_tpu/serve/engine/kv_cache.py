@@ -28,6 +28,8 @@ import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.util.lockwitness import named_lock
+
 import numpy as np
 
 __all__ = ["PageAllocator", "PagedKVCache"]
@@ -137,7 +139,7 @@ class PagedKVCache:
         self.allocator = PageAllocator(num_pages, page_size)
         self.tables = np.full((self.num_slots, self.pages_per_slot), -1, np.int32)
         self._slot_pages: Dict[int, List[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("PagedKVCache._lock")
 
     @property
     def max_tokens_per_slot(self) -> int:

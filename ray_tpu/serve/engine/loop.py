@@ -39,6 +39,8 @@ from ray_tpu.serve.engine.scheduler import (
     EngineRequest,
     EngineScheduler,
 )
+from ray_tpu.tools import graftsan
+from ray_tpu.util.lockwitness import named_lock, named_rlock
 
 __all__ = ["EngineConfig", "InferenceEngine", "BufferSink"]
 
@@ -80,7 +82,7 @@ class BufferSink:
         self.overloaded = False
         self._done = threading.Event()
         self._cbs: List[Any] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("BufferSink._lock")
 
     def emit(self, frame: dict) -> None:
         """Engine-thread only (single producer)."""
@@ -137,7 +139,7 @@ class InferenceEngine:
         self.sched = EngineScheduler(
             self.cache, max_queue=cfg.max_queue, prefill_chunk=cfg.prefill_chunk
         )
-        self._lock = threading.RLock()
+        self._lock = named_rlock("InferenceEngine._lock")
         # stream sinks with frames still queued for the wire: the ring is
         # finite, so streams longer than it need flush retries after the
         # consumer drains slots — the loop (and the idle tick) provide them
@@ -202,6 +204,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ the loop
 
+    @graftsan.loop_root
     def _run(self) -> None:
         # the resident loop is its own profiler role: sampled stacks from
         # this thread aggregate under "engine", not the host worker, so

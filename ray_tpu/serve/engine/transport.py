@@ -34,6 +34,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.exceptions import EngineStreamError
+from ray_tpu.util.lockwitness import named_condition, named_lock
 
 __all__ = ["StreamHub", "StreamState", "TokenStream", "hub", "open_token_stream"]
 
@@ -50,8 +51,8 @@ class StreamState:
         self.sid = sid
         self._limit = int(outbox_limit)
         self._frames: collections.deque = collections.deque()
-        self._cv = threading.Condition()
-        self._flush_lock = threading.Lock()
+        self._cv = named_condition("StreamState._cv")
+        self._flush_lock = named_lock("StreamState._flush_lock")
         self._writer = None
         self._conn = None
         self._seq = 0
@@ -198,7 +199,7 @@ class StreamHub:
 
     def __init__(self):
         self._streams: Dict[int, StreamState] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("StreamHub._lock")
         self._next = 1
 
     def create(self, outbox_limit: int = 4096, cancel_cb=None) -> StreamState:
@@ -247,7 +248,7 @@ class StreamHub:
 
 
 _hub: Optional[StreamHub] = None
-_hub_lock = threading.Lock()
+_hub_lock = named_lock("ray_tpu.serve.engine.transport._hub_lock")
 
 
 def hub() -> StreamHub:

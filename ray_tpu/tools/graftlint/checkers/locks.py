@@ -1,4 +1,5 @@
 """GL005 lock-discipline — guarded module-level mutable state.
+GL011 anonymous-lock — named witness locks in witness-aware modules.
 
 The raylet spawns workers on executor threads; the GCS head runs persist
 ticks and spill hooks on side threads; core_worker batches ref-adds from
@@ -10,6 +11,14 @@ inside a function must happen under a ``with <lock>`` (anything whose
 name contains "lock"), inside a ``*_locked`` method (callers hold the
 lock by convention), or on a variable annotated
 ``# graftlint: guarded-by=<lock>`` at its definition.
+
+GL011 anonymous-lock — a module that imports
+``ray_tpu.util.lockwitness`` has opted its locks into the runtime
+lock-order witness; a bare ``threading.Lock()`` / ``RLock()`` /
+``Condition()`` in such a module creates a lock the witness cannot see
+(and graftsan's static lock-order pass cannot correlate with the
+runtime graph).  Use ``named_lock("Class._attr")`` & friends — the name
+must match the static identity graftsan derives from the attribute.
 """
 
 from __future__ import annotations
@@ -207,3 +216,54 @@ class LockDisciplineChecker(FileChecker):
         visitor = _GuardVisitor(self, ctx, candidates)
         visitor.visit(ctx.tree)
         yield from visitor.findings
+
+
+_BARE_LOCK_FACTORIES = {
+    "threading.Lock": "named_lock",
+    "threading.RLock": "named_rlock",
+    "threading.Condition": "named_condition",
+}
+
+
+def _imports_lockwitness(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.level == 0 and node.module == "ray_tpu.util.lockwitness":
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name == "ray_tpu.util.lockwitness" for a in node.names):
+                return True
+    return False
+
+
+@register
+class AnonymousLockChecker(FileChecker):
+    rule = Rule(
+        "GL011",
+        "anonymous-lock",
+        "witness-aware modules must name their locks (named_lock & friends)",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # lockwitness.py itself wraps the raw primitives; everywhere else,
+        # importing it is the opt-in that makes bare locks a bug
+        return ctx.basename != "lockwitness.py"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _imports_lockwitness(ctx.tree):
+            return
+        aliases = import_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func, aliases)
+            wanted = _BARE_LOCK_FACTORIES.get(name)
+            if wanted is not None:
+                yield ctx.finding(
+                    self.rule,
+                    node,
+                    f"bare {name}() in a module that imports lockwitness: "
+                    "this lock is invisible to the runtime order witness and "
+                    f"to graftsan's static/runtime correlation — use "
+                    f"{wanted}(\"Class._attr\") (name = graftsan's static id)",
+                )

@@ -30,10 +30,18 @@ import os
 import re
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*(disable|disable-file)=([A-Za-z0-9_,\-\s]+?)"
-    r"(?:\s*--\s*(?P<reason>.*))?\s*$"
-)
+def _suppress_re(tool: str) -> "re.Pattern[str]":
+    """Suppression-comment pattern for one tool namespace.  graftsan
+    (tools/graftsan) reuses this whole file model with its own comment
+    prefix, so `# graftsan: disable=...` never silences a graftlint rule
+    and vice versa."""
+    return re.compile(
+        rf"#\s*{tool}:\s*(disable|disable-file)=([A-Za-z0-9_,\-\s]+?)"
+        r"(?:\s*--\s*(?P<reason>.*))?\s*$"
+    )
+
+
+_SUPPRESS_RE = _suppress_re("graftlint")
 
 PARSE_ERROR_RULE_ID = "GL000"
 PARSE_ERROR_RULE_NAME = "parse-error"
@@ -72,12 +80,23 @@ class Finding:
 class FileContext:
     """One parsed source file plus its suppression table."""
 
-    def __init__(self, path: str, relpath: str, source: str, tree: ast.AST):
+    def __init__(
+        self,
+        path: str,
+        relpath: str,
+        source: str,
+        tree: ast.AST,
+        tool: str = "graftlint",
+    ):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        self.tool = tool
+        self._suppress_pattern = (
+            _SUPPRESS_RE if tool == "graftlint" else _suppress_re(tool)
+        )
         # line -> set of suppressed rule names; "all" suppresses everything
         self.line_suppressions: Dict[int, Set[str]] = {}
         self.file_suppressions: Set[str] = set()
@@ -93,7 +112,7 @@ class FileContext:
 
     def _scan_suppressions(self) -> None:
         for lineno, text in enumerate(self.lines, start=1):
-            m = _SUPPRESS_RE.search(text)
+            m = self._suppress_pattern.search(text)
             if not m:
                 continue
             names = {n.strip() for n in m.group(2).split(",") if n.strip()}
@@ -284,6 +303,7 @@ def collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
 
 def parse_files(
     paths: Sequence[str],
+    tool: str = "graftlint",
 ) -> Tuple[List[FileContext], List[Finding]]:
     ctxs: List[FileContext] = []
     errors: List[Finding] = []
@@ -304,7 +324,7 @@ def parse_files(
                 )
             )
             continue
-        ctxs.append(FileContext(abspath, relpath, source, tree))
+        ctxs.append(FileContext(abspath, relpath, source, tree, tool=tool))
     return ctxs, errors
 
 

@@ -15,13 +15,15 @@ from ray_tpu.tools.graftlint.core import Finding
 JSON_SCHEMA_VERSION = 1
 
 
-def format_text(findings: List[Finding], statistics: bool = False) -> str:
+def format_text(
+    findings: List[Finding], statistics: bool = False, tool: str = "graftlint"
+) -> str:
     lines = [
         f"{f.path}:{f.line}:{f.col}: {f.rule_id} [{f.rule_name}] {f.message}"
         for f in findings
     ]
     if not findings:
-        lines.append("graftlint: clean")
+        lines.append(f"{tool}: clean")
     if statistics:
         counts = Counter(f"{f.rule_id} [{f.rule_name}]" for f in findings)
         lines.append("")
@@ -31,11 +33,11 @@ def format_text(findings: List[Finding], statistics: bool = False) -> str:
     return "\n".join(lines)
 
 
-def format_json(findings: List[Finding]) -> str:
+def format_json(findings: List[Finding], tool: str = "graftlint") -> str:
     counts = Counter(f.rule_name for f in findings)
     doc = {
         "version": JSON_SCHEMA_VERSION,
-        "tool": "graftlint",
+        "tool": tool,
         "counts": dict(sorted(counts.items())),
         "total": len(findings),
         "findings": [f.to_dict() for f in findings],

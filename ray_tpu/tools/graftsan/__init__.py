@@ -1,0 +1,42 @@
+"""graftsan — whole-tree concurrency & protocol-contract analyzer.
+
+graftlint (tools/graftlint) checks per-statement invariants; graftsan
+works on an interprocedural call graph: which functions run on an
+event-loop thread, what blocks, what locks nest under what.  The rule
+catalog (GS001–GS005) lives in README.md next to this file; run it as
+``python -m ray_tpu.tools.graftsan [paths...]``.
+
+This ``__init__`` holds ONLY the runtime annotation registry, so runtime
+modules can import it without pulling the analyzer in:
+
+- ``@graftsan.loop_root`` marks a function as the body of a resident
+  loop thread (the serve-engine ``loop._run``, DAG executor node loops).
+  Every function statically reachable from a root is classified
+  "runs on a loop thread" and must not block (GS001).  ``async def``
+  functions are roots implicitly — they always run on an asyncio loop
+  here — so the decorator exists for the *thread*-shaped loops the
+  analyzer cannot infer.
+- ``@graftsan.blocking`` declares that a function blocks its calling
+  thread (e.g. a sync bridge that parks on a cross-thread future), so
+  every call site is treated like a builtin blocking call without the
+  analyzer having to see through the mechanism.
+
+Both are identity decorators at runtime (one attribute write, no
+wrapper frame): the analyzer reads them from the AST, never by import.
+"""
+
+from __future__ import annotations
+
+__all__ = ["loop_root", "blocking"]
+
+
+def loop_root(fn):
+    """Mark `fn` as the body of a resident loop thread (analyzer root)."""
+    fn.__graftsan_loop_root__ = True
+    return fn
+
+
+def blocking(fn):
+    """Declare that `fn` blocks its calling thread (analyzer blocking table)."""
+    fn.__graftsan_blocking__ = True
+    return fn

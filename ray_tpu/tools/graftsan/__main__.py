@@ -1,0 +1,62 @@
+"""CLI entry point: ``python -m ray_tpu.tools.graftsan [paths...]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ray_tpu.tools.graftlint.reporters import format_json, format_text
+from ray_tpu.tools.graftsan.rules import ALL_RULES, lint_paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftsan",
+        description=(
+            "Whole-tree concurrency & protocol-contract analysis for the "
+            "ray_tpu runtime (interprocedural: call graph, lock-order "
+            "graph, loop-thread reachability)."
+        ),
+    )
+    parser.add_argument("paths", nargs="*", default=["."], help="files or directories")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule ids/names to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", default="", help="comma-separated rule ids/names to skip"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    parser.add_argument(
+        "--statistics", action="store_true", help="append per-rule counts (text mode)"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(ALL_RULES, key=lambda r: r.id):
+            print(f"{rule.id}  {rule.name:24s} {rule.summary}")
+        return 0
+
+    select = [s for s in args.select.split(",") if s.strip()]
+    ignore = [s for s in args.ignore.split(",") if s.strip()]
+    try:
+        findings = lint_paths(args.paths or ["."], select=select, ignore=ignore)
+    except (OSError, ValueError) as e:
+        print(f"graftsan: {e}", file=sys.stderr)
+        return 2
+    if args.fmt == "json":
+        print(format_json(findings, tool="graftsan"))
+    else:
+        print(format_text(findings, statistics=args.statistics, tool="graftsan"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,613 @@
+"""Interprocedural call-graph model for graftsan.
+
+One pass over every parsed file builds a ``CallGraph``:
+
+- a ``FunctionInfo`` per function/method (nested defs included, keyed
+  under their parent), carrying the blocking-call sites, lock
+  acquisitions, and outgoing call sites found in its body;
+- a resolution index so call sites map to project functions: bare names
+  resolve through local nested defs → module functions → import
+  aliases; ``self.x()`` / ``cls.x()`` resolve through the enclosing
+  class and its project-local bases; ``mod.f()`` resolves through
+  import aliases; as a last resort, ``obj.m()`` resolves by method name
+  when exactly ONE project class defines ``m`` (unique-name fallback —
+  ambiguous names stay unresolved rather than guessing).
+
+Boundaries that deliberately CUT edges (they move work off-thread):
+
+- a nested ``def``/``lambda`` body creates no edge from its parent —
+  that is the run_in_executor / Thread(target=...) thunk shape; calling
+  the nested name inline (``thunk()``) still creates the edge;
+- bare references (``executor.submit(self._io)``) are not calls;
+- calling a GENERATOR function runs none of its body — the body runs
+  at iteration time, wherever the iterator is driven (the serve proxy
+  drives ``stream_tokens`` from an executor thread), so call edges
+  into generators propagate neither loop-ness nor lock reachability.
+
+Lock identity is best-effort static naming: ``self._lock`` inside
+``class Foo`` becomes ``Foo._lock``; a module-global ``_hub_lock``
+becomes ``<module>._hub_lock``.  That matches how util/lockwitness.py
+names the same locks at runtime, so the static lock-order graph
+(GS003) and the runtime witness speak one vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.tools.graftlint.core import FileContext, dotted_name, import_aliases
+
+# Receivers that look like synchronization objects, for `.acquire()` /
+# with-statement lock classification.
+LOCKISH_RE = re.compile(r"lock|mutex|cond|sem|(^|[._])cv($|[._])", re.IGNORECASE)
+
+# Method names that collide with builtin container/str/bytes methods can
+# never resolve through the unique-name fallback: `self._buf.append(x)`
+# is a list, not whatever project class happens to define `append`.
+_BUILTIN_METHODS = frozenset(
+    name
+    for t in (list, dict, set, str, bytes, tuple, frozenset)
+    for name in dir(t)
+    if not name.startswith("__")
+)
+
+# ---------------------------------------------------------------- blocking table
+
+# kind -> reported as sync-thread-blocking for GS001; "rpc"/"wait" kinds
+# matter under a held lock (GS002) even when awaited.
+_DOTTED_BLOCKING = {
+    "time.sleep": ("sleep", "time.sleep() parks the thread"),
+    "os.fsync": ("io", "fsync stalls on disk"),
+    "os.fdatasync": ("io", "fdatasync stalls on disk"),
+    "os.waitpid": ("child", "waits for a child process"),
+    "os.wait": ("child", "waits for a child process"),
+    "select.select": ("io", "blocks in select"),
+    "socket.create_connection": ("io", "synchronous connect"),
+    "urllib.request.urlopen": ("io", "synchronous HTTP"),
+    "requests.get": ("io", "synchronous HTTP"),
+    "requests.post": ("io", "synchronous HTTP"),
+}
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "getoutput"}
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSite:
+    line: int
+    col: int
+    label: str  # e.g. "time.sleep", ".result()"
+    kind: str  # sleep | io | child | result | join | acquire | rpc | wait | queue | annotated
+    why: str
+    awaited: bool
+    locks_held: Tuple[str, ...]
+
+    @property
+    def sync_blocking(self) -> bool:
+        """Blocks the calling THREAD (an awaited rpc yields the loop)."""
+        return not self.awaited
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    line: int
+    col: int
+    callees: Tuple[str, ...]  # resolved FunctionInfo keys
+    label: str
+    awaited: bool
+    locks_held: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class LockEdge:
+    held: str
+    acquired: str
+    relpath: str  # file of the acquisition site (suppression anchor)
+    line: int
+    col: int
+    path: str  # human-readable provenance ("Foo.a -> Bar.b")
+
+
+class FunctionInfo:
+    def __init__(self, key, ctx, qualname, node, class_name):
+        self.key: str = key  # "relpath::Qual"
+        self.ctx: FileContext = ctx
+        self.qualname: str = qualname  # "Class.method" / "func" / "func.<nested>"
+        self.node = node
+        self.class_name: Optional[str] = class_name
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.is_generator = _is_generator(node)
+        self.is_loop_root = False
+        self.is_blocking_annotated = False
+        self.block_sites: List[BlockSite] = []
+        self.calls: List[CallSite] = []
+        # with-statement acquisitions: (lock_id, line, locks_already_held)
+        self.with_locks: List[Tuple[str, int, Tuple[str, ...]]] = []
+        # bare `.acquire()` acquisitions (held region unknown):
+        # (lock_id, line, locks_held_at_site)
+        self.acquire_locks: List[Tuple[str, int, Tuple[str, ...]]] = []
+        self.local_names: Dict[str, str] = {}  # nested def name -> key
+
+    @property
+    def short(self) -> str:
+        return f"{self.ctx.relpath}:{self.qualname}"
+
+
+def _module_name(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _is_generator(node) -> bool:
+    """A sync ``def`` whose own body (nested defs excluded) yields."""
+    if isinstance(node, ast.AsyncFunctionDef):
+        return False
+    stack = list(node.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Yield, ast.YieldFrom)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _decorator_marks(node) -> Tuple[bool, bool]:
+    root = blocking = False
+    for dec in node.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(d)
+        if name.endswith("loop_root"):
+            root = True
+        elif name.endswith("blocking") and "graftsan" in name:
+            blocking = True
+    return root, blocking
+
+
+def _lock_id(expr: ast.expr, class_name: Optional[str], module: str) -> Optional[str]:
+    """Static identity for a lock expression, or None if not lock-shaped."""
+    name = dotted_name(expr)
+    if not name or not LOCKISH_RE.search(name):
+        return None
+    parts = name.split(".")
+    if parts[0] in ("self", "cls"):
+        owner = class_name or module
+        return f"{owner}.{'.'.join(parts[1:])}"
+    if len(parts) == 1:
+        return f"{module}.{name}"
+    return name
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    """Extract block sites / call sites / lock spans from ONE function body
+    (nested defs are indexed separately and not descended into here)."""
+
+    def __init__(self, graph: "CallGraph", info: FunctionInfo, aliases):
+        self.graph = graph
+        self.info = info
+        self.aliases = aliases
+        self.module = _module_name(info.ctx.relpath)
+        self._lock_stack: List[str] = []
+        self._awaited: Set[int] = set()  # id()s of Call nodes under Await
+
+    # -- structure ----------------------------------------------------------
+
+    def visit_FunctionDef(self, node):  # nested def: boundary, no edge
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return
+
+    def visit_Await(self, node: ast.Await):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def _visit_with(self, node, is_async: bool):
+        acquired: List[str] = []
+        if not is_async:  # async with = asyncio lock; different discipline
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                lid = _lock_id(expr, self.info.class_name, self.module)
+                if lid:
+                    self.info.with_locks.append(
+                        (lid, node.lineno, tuple(self._lock_stack))
+                    )
+                    acquired.append(lid)
+        for item in node.items:
+            self.visit(item.context_expr)
+        self._lock_stack.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self._lock_stack.pop()
+
+    def visit_With(self, node):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node, is_async=True)
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        awaited = id(node) in self._awaited
+        held = tuple(self._lock_stack)
+        self._classify_blocking(node, awaited, held)
+        callees = self.graph._resolve(self.info, node, self.aliases)
+        if callees:
+            self.info.calls.append(
+                CallSite(
+                    node.lineno,
+                    node.col_offset,
+                    tuple(callees),
+                    dotted_name(node.func, self.aliases) or "<call>",
+                    awaited,
+                    held,
+                )
+            )
+        self.generic_visit(node)
+
+    def _classify_blocking(self, node: ast.Call, awaited: bool, held):
+        name = dotted_name(node.func, self.aliases)
+        entry = _DOTTED_BLOCKING.get(name)
+        kind = why = None
+        label = name
+        if entry:
+            kind, why = entry
+        elif name.startswith("subprocess.") and name.split(".")[-1] in _SUBPROCESS_BLOCKING:
+            kind, why = "child", "blocks until the child exits"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = dotted_name(node.func.value, self.aliases)
+            label = f"{recv or '<expr>'}.{attr}()"
+            if attr == "result":
+                kind, why = "result", (
+                    "parks the thread on a cross-thread future"
+                    if not node.args and not node.keywords
+                    else "parks the thread on a cross-thread future (bounded "
+                    "by its timeout, but the loop stalls for that long)"
+                )
+            elif attr == "join" and not node.args and not node.keywords:
+                # str.join takes an argument; zero-arg join is thread/proc
+                kind, why = "join", "waits for a thread/process to exit"
+            elif attr in ("communicate", "wait_for_termination"):
+                kind, why = "child", "blocks until the child exits"
+            elif attr == "acquire" and recv and LOCKISH_RE.search(recv):
+                if not _nonblocking_acquire(node):
+                    kind, why = "acquire", (
+                        "unbounded lock acquire; prefer `with lock:` in "
+                        "thread code, never on a loop thread"
+                    )
+            elif attr == "request" and (
+                _first_arg_is_msgtype(node) or (recv and "conn" in recv.lower())
+            ):
+                kind, why = "rpc", "a control RPC round-trip"
+            elif attr == "wait" and recv:
+                if self._condition_idiom(recv):
+                    pass  # cv.wait() under `with cv:` is the condition idiom
+                elif LOCKISH_RE.search(recv) or _eventish(recv):
+                    kind, why = "wait", "parks the thread on a synchronization object"
+            elif attr == "get" and recv and "queue" in recv.lower():
+                if not any(
+                    isinstance(a, ast.Constant) and a.value is False for a in node.args
+                ) and not any(k.arg == "block" for k in node.keywords):
+                    kind, why = "queue", "blocks on an empty queue"
+        if kind:
+            self.info.block_sites.append(
+                BlockSite(node.lineno, node.col_offset, label, kind, why, awaited, held)
+            )
+        # `.acquire()` also participates in the lock-order graph
+        if kind == "acquire" or (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "acquire"
+        ):
+            lid = _lock_id(node.func.value, self.info.class_name, self.module)
+            if lid:
+                self.info.acquire_locks.append((lid, node.lineno, held))
+
+    def _condition_idiom(self, recv: str) -> bool:
+        lid = None
+        try:
+            expr = ast.parse(recv, mode="eval").body
+            lid = _lock_id(expr, self.info.class_name, self.module)
+        except SyntaxError:
+            pass
+        return bool(lid and self._lock_stack and self._lock_stack[-1] == lid)
+
+
+def _eventish(recv: str) -> bool:
+    last = recv.split(".")[-1].lower()
+    return any(s in last for s in ("event", "ready", "done", "stopped", "_ev", "barrier"))
+
+
+def _nonblocking_acquire(node: ast.Call) -> bool:
+    if node.args and isinstance(node.args[0], ast.Constant) and node.args[0].value is False:
+        return True
+    for kw in node.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) and kw.value.value is False:
+            return True
+        if kw.arg == "timeout":
+            return True
+    return bool(node.args and len(node.args) >= 2)  # acquire(True, timeout)
+
+
+def _first_arg_is_msgtype(node: ast.Call) -> bool:
+    return bool(
+        node.args
+        and isinstance(node.args[0], ast.Attribute)
+        and isinstance(node.args[0].value, ast.Name)
+        and node.args[0].value.id == "MsgType"
+    )
+
+
+class CallGraph:
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_module_func: Dict[Tuple[str, str], str] = {}
+        self._by_class_method: Dict[Tuple[str, str], List[str]] = {}
+        self._by_method: Dict[str, List[str]] = {}
+        self._class_bases: Dict[str, List[str]] = {}
+        self._handler_values: Set[str] = set()
+        for ctx in ctxs:
+            self._index_file(ctx)
+        for ctx in ctxs:
+            self._extract_file(ctx)
+        self._mark_handler_roots(ctxs)
+        self._reach_blocking_memo: Dict[str, Optional[Tuple[BlockSite, str]]] = {}
+        self._acquires_memo: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------------- indexing
+
+    def _index_file(self, ctx: FileContext) -> None:
+        module = _module_name(ctx.relpath)
+
+        def walk(body, qual_prefix, class_name, parent: Optional[FunctionInfo]):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{qual_prefix}{stmt.name}"
+                    key = f"{ctx.relpath}::{qual}"
+                    info = FunctionInfo(key, ctx, qual, stmt, class_name)
+                    info.is_loop_root, info.is_blocking_annotated = _decorator_marks(stmt)
+                    self.functions[key] = info
+                    if class_name:
+                        self._by_class_method.setdefault(
+                            (class_name, stmt.name), []
+                        ).append(key)
+                        self._by_method.setdefault(stmt.name, []).append(key)
+                    elif parent is None:
+                        self._by_module_func[(module, stmt.name)] = key
+                    if parent is not None:
+                        parent.local_names[stmt.name] = key
+                    walk(stmt.body, f"{qual}.", class_name, info)
+                elif isinstance(stmt, ast.ClassDef):
+                    self._class_bases.setdefault(
+                        stmt.name, [dotted_name(b).split(".")[-1] for b in stmt.bases]
+                    )
+                    walk(stmt.body, f"{stmt.name}.", stmt.name, None)
+                elif isinstance(stmt, (ast.If, ast.Try)):
+                    for sub in ast.iter_child_nodes(stmt):
+                        if isinstance(sub, ast.stmt):
+                            walk([sub], qual_prefix, class_name, parent)
+
+        walk(ctx.tree.body, "", None, None)
+
+    def _extract_file(self, ctx: FileContext) -> None:
+        aliases = import_aliases(ctx.tree)
+        for info in self.functions.values():
+            if info.ctx is not ctx:
+                continue
+            visitor = _BodyVisitor(self, info, aliases)
+            for stmt in info.node.body:
+                visitor.visit(stmt)
+
+    def _mark_handler_roots(self, ctxs: Sequence[FileContext]) -> None:
+        """Values of ``*_HANDLERS`` dict literals run on the serving loop
+        by construction — treat them as roots even if referenced as
+        ``Class.method`` (an unbound reference, not a call)."""
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Dict
+                ):
+                    continue
+                targets = [
+                    t.attr if isinstance(t, ast.Attribute) else getattr(t, "id", "")
+                    for t in node.targets
+                ]
+                if not any("_HANDLERS" in (t or "") for t in targets):
+                    continue
+                for v in node.value.values:
+                    name = dotted_name(v)
+                    if not name:
+                        continue
+                    parts = name.split(".")
+                    for key in self._by_class_method.get(
+                        (parts[-2], parts[-1]) if len(parts) >= 2 else ("", ""), []
+                    ):
+                        self.functions[key].is_loop_root = True
+                    if len(parts) == 1:
+                        k = self._by_module_func.get((_module_name(ctx.relpath), name))
+                        if k:
+                            self.functions[k].is_loop_root = True
+
+    # ----------------------------------------------------------- resolution
+
+    def _mro_methods(self, class_name: str, method: str) -> List[str]:
+        seen, queue, out = set(), [class_name], []
+        while queue:
+            cn = queue.pop(0)
+            if cn in seen:
+                continue
+            seen.add(cn)
+            hit = self._by_class_method.get((cn, method))
+            if hit:
+                out.extend(hit)
+                break  # nearest definition wins, like the MRO would
+            queue.extend(self._class_bases.get(cn, []))
+        return out
+
+    def _resolve(self, info: FunctionInfo, node: ast.Call, aliases) -> List[str]:
+        module = _module_name(info.ctx.relpath)
+        f = node.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in info.local_names:
+                return [info.local_names[name]]
+            k = self._by_module_func.get((module, name))
+            if k:
+                return [k]
+            target = aliases.get(name)
+            if target and "." in target:
+                mod, _, fname = target.rpartition(".")
+                k = self._by_module_func.get((mod, fname))
+                if k:
+                    return [k]
+                # `from x import Class` + Class() → constructor
+                hits = self._mro_methods(fname, "__init__")
+                if hits:
+                    return hits
+            hits = self._by_class_method.get((name, "__init__"))
+            if hits:
+                return list(hits)
+            return []
+        if isinstance(f, ast.Attribute):
+            base = dotted_name(f.value, aliases)
+            if base in ("self", "cls") and info.class_name:
+                return self._mro_methods(info.class_name, f.attr)
+            if base:
+                mod_key = self._by_module_func.get((base, f.attr))
+                if mod_key:
+                    return [mod_key]
+                parts = base.split(".")
+                hits = self._by_class_method.get((parts[-1], f.attr))
+                if hits and len(hits) == 1:
+                    return list(hits)
+            # unique-name fallback: exactly one project class defines it,
+            # and the name cannot be a builtin container/str method
+            if f.attr not in _BUILTIN_METHODS:
+                hits = self._by_method.get(f.attr, [])
+                if len(hits) == 1:
+                    return list(hits)
+        return []
+
+    # ------------------------------------------------------------ summaries
+
+    def on_loop_functions(self) -> Dict[str, Tuple[str, ...]]:
+        """Map of fn key -> root path (root ... -> fn) for every function
+        that can run on an event-loop thread."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        queue: List[str] = []
+        for key, info in self.functions.items():
+            if info.is_loop_root or info.is_async:
+                out[key] = (key,)
+                queue.append(key)
+        while queue:
+            key = queue.pop()
+            info = self.functions[key]
+            path = out[key]
+            if len(path) > 24:
+                continue
+            for call in info.calls:
+                for callee in call.callees:
+                    ci = self.functions.get(callee)
+                    if ci is not None and ci.is_generator:
+                        continue  # lazy: the body runs at iteration time
+                    if callee not in out:
+                        out[callee] = path + (callee,)
+                        queue.append(callee)
+        return out
+
+    def reachable_blocking(self, key: str) -> Optional[Tuple[BlockSite, str]]:
+        """First sync-blocking site reachable from `key` (inclusive), with
+        a human-readable path, or None.  Annotated-blocking callees count
+        as a site at the call line."""
+        memo = self._reach_blocking_memo
+        if key in memo:
+            return memo[key]
+        memo[key] = None  # cycle guard: a cycle contributes nothing new
+        info = self.functions.get(key)
+        if info is None or info.is_generator:
+            return None  # lazy: a generator's body runs at iteration time
+        for site in info.block_sites:
+            # bare lock acquires are the lock-ORDER graph's domain (GS003);
+            # treating them as blocking here would flag every nested-lock
+            # helper called under a lock
+            if site.sync_blocking and site.kind != "acquire":
+                memo[key] = (site, info.short)
+                return memo[key]
+        for call in info.calls:
+            for callee in call.callees:
+                ci = self.functions.get(callee)
+                if ci is not None and ci.is_blocking_annotated:
+                    site = BlockSite(
+                        call.line,
+                        call.col,
+                        f"{ci.qualname}()",
+                        "annotated",
+                        "declared @graftsan.blocking",
+                        call.awaited,
+                        call.locks_held,
+                    )
+                    memo[key] = (site, info.short)
+                    return memo[key]
+                sub = self.reachable_blocking(callee)
+                if sub is not None:
+                    memo[key] = (sub[0], f"{info.short} -> {sub[1]}")
+                    return memo[key]
+        return memo[key]
+
+    def transitive_acquires(self, key: str) -> Set[str]:
+        memo = self._acquires_memo
+        if key in memo:
+            return memo[key]
+        memo[key] = set()  # cycle guard
+        info = self.functions.get(key)
+        if info is None:
+            return memo[key]
+        acc: Set[str] = {lid for lid, _, _ in info.with_locks}
+        acc |= {lid for lid, _, _ in info.acquire_locks}
+        for call in info.calls:
+            for callee in call.callees:
+                acc |= self.transitive_acquires(callee)
+        memo[key] = acc
+        return acc
+
+    def lock_edges(self) -> List[LockEdge]:
+        """held -> acquired edges: direct `with` nesting plus lock sets
+        transitively acquired by calls made under a held lock."""
+        edges: Dict[Tuple[str, str], LockEdge] = {}
+
+        def add(held, acquired, relpath, line, col, path):
+            if held == acquired:
+                return  # reentrant same-lock (RLock) is not an ordering edge
+            edges.setdefault(
+                (held, acquired), LockEdge(held, acquired, relpath, line, col, path)
+            )
+
+        for info in self.functions.values():
+            rp = info.ctx.relpath
+            for lid, line, held_stack in info.with_locks:
+                for held in held_stack:
+                    add(held, lid, rp, line, 0, info.short)
+            for call in info.calls:
+                if not call.locks_held:
+                    continue
+                for callee in call.callees:
+                    for lid in self.transitive_acquires(callee):
+                        ci = self.functions.get(callee)
+                        via = ci.short if ci else callee
+                        for held in call.locks_held:
+                            add(held, lid, rp, call.line, call.col, f"{info.short} -> {via}")
+            for lid, line, held_stack in info.acquire_locks:
+                for held in held_stack:
+                    add(held, lid, rp, line, 0, info.short)
+        return list(edges.values())

@@ -1,0 +1,541 @@
+"""graftsan rules GS001–GS005 and the runner.
+
+Each rule is a function ``(graph, ctxs) -> Iterator[Finding]``; the
+runner builds one CallGraph over the scanned tree, runs every selected
+rule, and applies ``# graftsan: disable=...`` suppressions (same
+comment grammar as graftlint, different namespace — a graftlint
+suppression never silences graftsan and vice versa).  See README.md for
+the catalog with the production bug each rule would have caught.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.tools.graftlint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    import_aliases,
+    parse_files,
+)
+from ray_tpu.tools.graftlint.checkers.protocol import _find_enum, _receiving_refs
+from ray_tpu.tools.graftsan.callgraph import BlockSite, CallGraph
+
+GS001 = Rule(
+    "GS001",
+    "loop-blocking-reachable",
+    "no blocking call reachable on an event-loop thread (interprocedural)",
+)
+GS002 = Rule(
+    "GS002",
+    "blocking-under-lock",
+    "no blocking call (or RPC await) reachable while a lock is held",
+)
+GS003 = Rule(
+    "GS003",
+    "lock-order-cycle",
+    "the static lock-order graph must be acyclic",
+)
+GS004 = Rule(
+    "GS004",
+    "protocol-coverage",
+    "every non-reserved MsgType: exactly one handler, at least one send site",
+)
+GS005 = Rule(
+    "GS005",
+    "protocol-send-contract",
+    "reply waits carry timeouts; idempotency-keyed frames carry their key",
+)
+
+ALL_RULES = [GS001, GS002, GS003, GS004, GS005]
+
+# Frame types whose send payloads must carry an idempotency key (the
+# receiver dedupes replays across conn loss / head restart on it).  A
+# payload we cannot resolve to a dict literal is skipped, not guessed.
+IDEMPOTENCY_KEYS = {
+    "ADD_REF": "batch",  # core_worker ref flushes: stable batch id
+    "REMOVE_REF": "batch",
+    "TASK_DONE": "task_id",  # head recent-done ring dedupes by task id
+    "LEASE_DONE": "results",  # per-result task ids inside the batch
+}
+
+# consumed by Connection.dispatch_reply / sent by Connection.reply
+_PROTOCOL_EXEMPT = {"REPLY", "ERROR_REPLY"}
+
+
+def _qual_path(graph: CallGraph, keys: Sequence[str], limit: int = 5) -> str:
+    names = [graph.functions[k].short for k in keys if k in graph.functions]
+    if len(names) > limit:
+        names = names[:2] + ["..."] + names[-(limit - 3) :]
+    return " -> ".join(names)
+
+
+def _ctx_for(ctxs: Sequence[FileContext], relpath: str) -> Optional[FileContext]:
+    for c in ctxs:
+        if c.relpath == relpath:
+            return c
+    return None
+
+
+# ----------------------------------------------------------------- GS001
+
+
+def check_loop_blocking(graph: CallGraph, ctxs) -> Iterator[Finding]:
+    on_loop = graph.on_loop_functions()
+    seen: Set[Tuple[str, int, str]] = set()
+    for key, path in sorted(on_loop.items()):
+        info = graph.functions[key]
+        for site in info.block_sites:
+            if not site.sync_blocking:
+                continue  # an awaited call yields the loop
+            dedup = (info.ctx.relpath, site.line, site.label)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            root = graph.functions[path[0]]
+            how = (
+                "a loop root"
+                if len(path) == 1
+                else f"loop root via {_qual_path(graph, path)}"
+            )
+            yield info.ctx.finding(
+                GS001,
+                site.line,
+                f"{site.label} blocks an event-loop thread ({site.why}); "
+                f"`{info.qualname}` is {how} "
+                f"(root: {root.qualname})",
+            )
+        # a call to an @graftsan.blocking function from loop context
+        for call in info.calls:
+            for callee in call.callees:
+                ci = graph.functions.get(callee)
+                if ci is None or not ci.is_blocking_annotated or call.awaited:
+                    continue
+                dedup = (info.ctx.relpath, call.line, ci.qualname)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                yield info.ctx.finding(
+                    GS001,
+                    call.line,
+                    f"`{ci.qualname}` is declared @graftsan.blocking and "
+                    f"`{info.qualname}` runs on a loop thread "
+                    f"({_qual_path(graph, path)})",
+                )
+
+
+# ----------------------------------------------------------------- GS002
+
+
+def check_blocking_under_lock(graph: CallGraph, ctxs) -> Iterator[Finding]:
+    seen: Set[Tuple[str, int]] = set()
+    for key in sorted(graph.functions):
+        info = graph.functions[key]
+        # direct blocking sites inside a `with <lock>:` body
+        for site in info.block_sites:
+            if not site.locks_held or site.kind == "acquire":
+                continue
+            if site.awaited and site.kind != "rpc":
+                continue  # awaited non-RPC yields; awaited RPC under a
+                # sync lock still wedges every other acquirer for the RTT
+            dedup = (info.ctx.relpath, site.line)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            yield info.ctx.finding(
+                GS002,
+                site.line,
+                f"{site.label} while holding {site.locks_held[-1]} "
+                f"({site.why}); every other thread needing the lock stalls "
+                f"behind it — in `{info.qualname}`",
+            )
+        # calls made under a lock whose callee (transitively) blocks
+        for call in info.calls:
+            if not call.locks_held:
+                continue
+            for callee in call.callees:
+                found = graph.reachable_blocking(callee)
+                if found is None:
+                    continue
+                site, via = found
+                dedup = (info.ctx.relpath, call.line)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                yield info.ctx.finding(
+                    GS002,
+                    call.line,
+                    f"`{call.label}` called while holding "
+                    f"{call.locks_held[-1]} reaches {site.label} "
+                    f"({site.why}) via {via}",
+                )
+
+
+# ----------------------------------------------------------------- GS003
+
+
+def check_lock_order(graph: CallGraph, ctxs) -> Iterator[Finding]:
+    # suppressions apply to EDGES: a `# graftsan: disable=GS003 -- reason`
+    # on an acquisition site declares that edge safe (e.g. the two locks
+    # provably never overlap), which is what actually breaks a cycle
+    edges = []
+    for e in graph.lock_edges():
+        ctx = _ctx_for(ctxs, e.relpath)
+        if ctx is not None and (
+            ctx.suppressed(GS003.name, e.line) or ctx.suppressed(GS003.id, e.line)
+        ):
+            continue
+        edges.append(e)
+    adj: Dict[str, List] = {}
+    for e in edges:
+        adj.setdefault(e.held, []).append(e)
+
+    # iterative DFS cycle detection; every distinct back-edge cycle is
+    # reported once, anchored at its lexicographically-first edge site
+    reported: Set[Tuple[str, ...]] = set()
+    visited: Set[str] = set()
+
+    def dfs(start: str):
+        stack = [(start, iter(adj.get(start, ())))]
+        on_path = {start: None}
+        order = [start]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for e in it:
+                if e.acquired in on_path:
+                    # back edge: reconstruct the cycle
+                    idx = order.index(e.acquired)
+                    cycle_nodes = order[idx:] + [e.acquired]
+                    canon = tuple(sorted(set(cycle_nodes)))
+                    if canon in reported:
+                        continue
+                    reported.add(canon)
+                    cyc_edges = []
+                    for a, b in zip(cycle_nodes, cycle_nodes[1:]):
+                        for ce in adj.get(a, ()):
+                            if ce.acquired == b:
+                                cyc_edges.append(ce)
+                                break
+                    yield cycle_nodes, cyc_edges
+                    continue
+                if e.acquired in adj and e.acquired not in visited:
+                    on_path[e.acquired] = None
+                    order.append(e.acquired)
+                    stack.append((e.acquired, iter(adj.get(e.acquired, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                n, _ = stack.pop()
+                visited.add(n)
+                on_path.pop(n, None)
+                if order and order[-1] == n:
+                    order.pop()
+
+    for start in sorted(adj):
+        if start in visited:
+            continue
+        for cycle_nodes, cyc_edges in dfs(start):
+            anchor = min(cyc_edges, key=lambda e: (e.relpath, e.line))
+            ctx = _ctx_for(ctxs, anchor.relpath)
+            desc = " -> ".join(cycle_nodes)
+            sites = "; ".join(
+                f"{e.held}->{e.acquired} at {e.relpath}:{e.line} ({e.path})"
+                for e in cyc_edges
+            )
+            finding = Finding(
+                anchor.relpath,
+                anchor.line,
+                anchor.col,
+                GS003.id,
+                GS003.name,
+                f"lock-order cycle {desc}: two threads taking these locks "
+                f"in opposite orders deadlock. edges: {sites}. break the "
+                "cycle, or suppress the edge that provably cannot overlap",
+            )
+            if ctx is None or not (
+                ctx.suppressed(GS003.name, finding.line)
+                or ctx.suppressed(GS003.id, finding.line)
+            ):
+                yield finding
+
+
+# ------------------------------------------------------------ GS004/GS005
+
+
+def _awaited_calls(tree: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+def _msgtype_aliases(ctx: FileContext) -> Set[str]:
+    """Local names the MsgType enum is visible under in this file
+    (``MsgType`` itself plus ``from ... import MsgType as _M`` aliases)."""
+    names = {"MsgType"}
+    for local, target in import_aliases(ctx.tree).items():
+        if target.split(".")[-1] == "MsgType":
+            names.add(local)
+    return names
+
+
+def _member_refs(expr: ast.AST, aliases: Set[str], members: Set[str]) -> Set[str]:
+    """Every enum member referenced anywhere inside ``expr`` as
+    ``MsgType.X`` / ``<alias>.X`` / ``protocol.MsgType.X`` — covers
+    conditional first args like ``A if blocked else B``."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Attribute) or node.attr not in members:
+            continue
+        base = dotted_name(node.value)
+        if base and (base in aliases or base.split(".")[-1] == "MsgType"):
+            out.add(node.attr)
+    return out
+
+
+def _iter_send_sites(
+    ctxs, members: Set[str]
+) -> Iterator[Tuple[FileContext, ast.Call, str, str]]:
+    """Yield (ctx, call, member, verb) for every ``*.send(MsgType.X, ...)``
+    / ``*.request(MsgType.X, ...)`` call (one yield per member when the
+    first arg is conditional)."""
+    for ctx in ctxs:
+        aliases = _msgtype_aliases(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            verb = node.func.attr
+            if verb not in ("send", "request") or not node.args:
+                continue
+            for member in sorted(_member_refs(node.args[0], aliases, members)):
+                yield ctx, node, member, verb
+
+
+_MATCH_CASE = getattr(ast, "match_case", type(None))
+
+
+def _send_evidence(ctx: FileContext, members: Set[str]) -> Set[str]:
+    """Members with at least one send-side reference in this file: any
+    ``MsgType.X`` occurrence that is NOT in a receiving position (handler
+    table key, dispatch comparison, match case).  Catches sends routed
+    through variables — batch tuples, conditional expressions, helper
+    returns — that a literal first-arg scan misses."""
+    aliases = _msgtype_aliases(ctx)
+    receiving: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Compare):
+            for sub in [node.left, *node.comparators]:
+                receiving.update(id(n) for n in ast.walk(sub))
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            targets = [
+                t.attr if isinstance(t, ast.Attribute) else getattr(t, "id", "")
+                for t in node.targets
+            ]
+            if any("_HANDLERS" in (t or "") for t in targets):
+                for k in node.value.keys:
+                    if k is not None:
+                        receiving.update(id(n) for n in ast.walk(k))
+        elif isinstance(node, _MATCH_CASE):
+            receiving.update(id(n) for n in ast.walk(node.pattern))
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in members
+            and id(node) not in receiving
+        ):
+            base = dotted_name(node.value)
+            if base and (base in aliases or base.split(".")[-1] == "MsgType"):
+                out.add(node.attr)
+    return out
+
+
+def _handler_entries(ctxs) -> Iterator[Tuple[FileContext, int, str]]:
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Dict):
+                continue
+            targets = [
+                t.attr if isinstance(t, ast.Attribute) else getattr(t, "id", "")
+                for t in node.targets
+            ]
+            if not any("_HANDLERS" in (t or "") for t in targets):
+                continue
+            for key in node.value.keys:
+                if (
+                    isinstance(key, ast.Attribute)
+                    and isinstance(key.value, ast.Name)
+                    and key.value.id == "MsgType"
+                ):
+                    yield ctx, key.lineno, key.attr
+
+
+def check_protocol_coverage(graph: CallGraph, ctxs) -> Iterator[Finding]:
+    enum_ctx, members = _find_enum(ctxs)
+    if not members:
+        return
+
+    registered: Dict[str, List[Tuple[FileContext, int]]] = {}
+    for ctx, lineno, member in _handler_entries(ctxs):
+        registered.setdefault(member, []).append((ctx, lineno))
+    received: Set[str] = set()
+    member_names = set(members)
+    sent: Set[str] = set()
+    for ctx in ctxs:
+        received.update(_receiving_refs(ctx.tree))
+        sent.update(_send_evidence(ctx, member_names))
+
+    for member, entries in sorted(registered.items()):
+        if len(entries) > 1:
+            ctx, lineno = entries[1]
+            tables = ", ".join(f"{c.relpath}:{ln}" for c, ln in entries)
+            yield ctx.finding(
+                GS004,
+                lineno,
+                f"MsgType.{member} is registered in {len(entries)} handler "
+                f"tables ({tables}): frames of one type must have exactly "
+                "one owner — a second registration silently shadows or "
+                "splits the protocol",
+            )
+
+    for name, (value, lineno) in sorted(members.items(), key=lambda kv: kv[1][1]):
+        if name in _PROTOCOL_EXEMPT:
+            continue
+        if name not in received:
+            yield enum_ctx.finding(
+                GS004,
+                lineno,
+                f"MsgType.{name} has no receiving side (no handler-table "
+                "entry or dispatch comparison): frames of this type are "
+                "dropped on the floor",
+            )
+        if name not in sent:
+            yield enum_ctx.finding(
+                GS004,
+                lineno,
+                f"MsgType.{name} has no send-side reference (every "
+                f"`MsgType.{name}` in the tree sits in a receiving "
+                "position): dead taxonomy — retire the slot or mark it "
+                "reserved with a reasoned suppression",
+            )
+
+
+def _resolve_payload_dict(
+    ctx: FileContext, call: ast.Call
+) -> Optional[List[str]]:
+    """Constant string keys of the payload (2nd arg) dict literal, chasing
+    one level of simple local `name = {...}` assignment.  None = cannot
+    resolve statically (skipped, never guessed)."""
+    if len(call.args) < 2:
+        return None
+    payload = call.args[1]
+    if isinstance(payload, ast.Name):
+        target = payload.id
+        assigns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+            and n.targets[0].id == target
+            and n.lineno < call.lineno
+            and call.lineno - n.lineno < 80
+        ]
+        if len(assigns) != 1 or not isinstance(assigns[-1].value, ast.Dict):
+            return None
+        payload = assigns[-1].value
+    if not isinstance(payload, ast.Dict):
+        return None
+    keys: List[str] = []
+    for k in payload.keys:
+        if k is None:
+            return None  # **splat: unresolvable
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.append(k.value)
+    return keys
+
+
+def check_send_contract(graph: CallGraph, ctxs) -> Iterator[Finding]:
+    _, members = _find_enum(ctxs)
+    awaited_by_ctx = {ctx.relpath: _awaited_calls(ctx.tree) for ctx in ctxs}
+    for ctx, call, member, verb in _iter_send_sites(ctxs, set(members)):
+        # (a) awaited reply waits need a bound: `await conn.request(t, p)`
+        # with no timeout parks the coroutine forever if the peer wedges
+        # (the sync CoreWorker.request fills rpc_timeout_s itself)
+        if verb == "request" and id(call) in awaited_by_ctx[ctx.relpath]:
+            has_timeout = len(call.args) >= 3 or any(
+                kw.arg == "timeout"
+                and not (isinstance(kw.value, ast.Constant) and kw.value.value is None)
+                for kw in call.keywords
+            )
+            if not has_timeout:
+                yield ctx.finding(
+                    GS005,
+                    call.lineno,
+                    f"await .request(MsgType.{member}, ...) without a "
+                    "timeout: a wedged or restarting peer parks this "
+                    "coroutine forever — pass an explicit bound",
+                )
+        # (b) idempotency-keyed frames must carry their key at every send
+        key = IDEMPOTENCY_KEYS.get(member)
+        if key:
+            keys = _resolve_payload_dict(ctx, call)
+            if keys is not None and key not in keys:
+                yield ctx.finding(
+                    GS005,
+                    call.lineno,
+                    f"MsgType.{member} payload lacks its idempotency key "
+                    f"'{key}': a replay after conn loss / head restart "
+                    "would be applied twice instead of deduped",
+                )
+
+
+# ------------------------------------------------------------------ runner
+
+_RULE_FUNCS = [
+    (GS001, check_loop_blocking),
+    (GS002, check_blocking_under_lock),
+    (GS003, check_lock_order),
+    (GS004, check_protocol_coverage),
+    (GS005, check_send_contract),
+]
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    ignore: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    ctxs, findings = parse_files(paths, tool="graftsan")
+    selected = {s for s in (select or ())}
+    ignored = {s for s in (ignore or ())}
+    known = {"GS000", "parse-error"}
+    for rule in ALL_RULES:
+        known |= {rule.id, rule.name}
+    unknown = (selected | ignored) - known
+    if unknown:
+        raise ValueError(f"unknown rule id/name: {', '.join(sorted(unknown))}")
+
+    graph = CallGraph(ctxs)
+    by_path = {c.relpath: c for c in ctxs}
+    for rule, fn in _RULE_FUNCS:
+        if selected and not ({rule.id, rule.name} & selected):
+            continue
+        if {rule.id, rule.name} & ignored:
+            continue
+        for f in fn(graph, ctxs):
+            c = by_path.get(f.path)
+            if c is not None and (
+                c.suppressed(f.rule_name, f.line) or c.suppressed(f.rule_id, f.line)
+            ):
+                continue
+            findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return findings

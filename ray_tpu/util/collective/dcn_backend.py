@@ -466,12 +466,14 @@ class DcnGroup:
             for step in range(n - 1):
                 send_idx = (self.rank - step) % n
                 recv_idx = (self.rank - step - 1) % n
+                # graftsan: disable=GS002 -- _lock serializes whole collectives on this group's ring sockets (a dedicated data-plane thread); socket IO under it IS the collective, bounded by the socket timeout
                 incoming = _exchange_array(self._next_sock, self._prev_sock, chunks[send_idx])
                 chunks[recv_idx] = _reduce_arrays(chunks[recv_idx], incoming, op)
             # allgather
             for step in range(n - 1):
                 send_idx = (self.rank + 1 - step) % n
                 recv_idx = (self.rank - step) % n
+                # graftsan: disable=GS002 -- same contract as the reduce-scatter phase above
                 chunks[recv_idx] = _exchange_array(
                     self._next_sock, self._prev_sock, chunks[send_idx]
                 )
@@ -505,6 +507,7 @@ class DcnGroup:
             current = pieces[self.rank]
             cur_rank = self.rank
             for _ in range(n - 1):
+                # graftsan: disable=GS002 -- same contract as allreduce: collectives serialize on _lock by design
                 current = _exchange_array(self._next_sock, self._prev_sock, current)
                 cur_rank = (cur_rank - 1) % n
                 pieces[cur_rank] = current

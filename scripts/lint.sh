@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
-# Static-analysis gate: graftlint (repo-invariant rules) + a bytecode
-# compile pass.  Exits nonzero on any new violation — see
-# ray_tpu/tools/graftlint/README.md for the rule catalog and how to
-# suppress intentional findings (with a reason).
+# Static-analysis gate: graftlint (per-file repo-invariant rules),
+# graftsan (whole-tree concurrency & protocol contracts: call graph,
+# lock-order graph, loop-thread reachability) and a bytecode compile
+# pass.  Exits nonzero on any new violation — see
+# ray_tpu/tools/graftlint/README.md and ray_tpu/tools/graftsan/README.md
+# for the rule catalogs and how to suppress intentional findings
+# (with a reason).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
 echo "== graftlint =="
 JAX_PLATFORMS=cpu python -m ray_tpu.tools.graftlint ray_tpu/ --statistics
+
+echo "== graftsan =="
+JAX_PLATFORMS=cpu python -m ray_tpu.tools.graftsan ray_tpu/ --statistics
 
 echo "== compile check =="
 python -m compileall -q ray_tpu/ tests/ examples/ scripts/

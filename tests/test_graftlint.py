@@ -699,6 +699,72 @@ def test_import_time_thread_allows_main_guard_and_functions(tmp_path):
     assert "import-time-thread" not in rules_in(findings)
 
 
+# --------------------------------------------------------------------- GL011
+
+
+def test_anonymous_lock_flagged_in_witness_aware_module(tmp_path):
+    findings = lint_file(
+        tmp_path,
+        "mod.py",
+        """
+        import threading
+
+        from ray_tpu.util.lockwitness import named_lock
+
+        _named = named_lock("mod._named")
+        _bare = threading.Lock()
+        """,
+    )
+    assert "anonymous-lock" in rules_in(findings)
+    assert len([f for f in findings if f.rule_name == "anonymous-lock"]) == 1
+
+
+def test_anonymous_lock_covers_rlock_and_condition(tmp_path):
+    findings = lint_file(
+        tmp_path,
+        "mod.py",
+        """
+        import threading
+
+        from ray_tpu.util.lockwitness import named_rlock
+
+        _r = threading.RLock()
+        _c = threading.Condition()
+        """,
+    )
+    assert len([f for f in findings if f.rule_name == "anonymous-lock"]) == 2
+
+
+def test_anonymous_lock_ignores_modules_without_lockwitness(tmp_path):
+    """Importing lockwitness is the opt-in: plain modules keep plain
+    locks without ceremony."""
+    findings = lint_file(
+        tmp_path,
+        "mod.py",
+        """
+        import threading
+
+        _bare = threading.Lock()
+        """,
+    )
+    assert "anonymous-lock" not in rules_in(findings)
+
+
+def test_anonymous_lock_suppression(tmp_path):
+    findings = lint_file(
+        tmp_path,
+        "mod.py",
+        """
+        import threading
+
+        from ray_tpu.util.lockwitness import named_lock
+
+        _bare = threading.Lock()  # graftlint: disable=anonymous-lock -- fixture: process-local scratch
+        """,
+    )
+    assert "anonymous-lock" not in rules_in(findings)
+
+
 # -------------------------------------------------------------- suppressions
 
 _VIOLATION = """
