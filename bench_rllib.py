@@ -105,6 +105,8 @@ def main():
             algo.policy.learn_on_loaded_batch(staged, algo.config.num_sgd_iter, 800)
         resident_steps_per_sec = n_up * B / (time.time() - t0)
 
+        obs_transfer = _bench_obs_transfer(B)
+
         sac = _bench_sac()
 
         result = (
@@ -128,6 +130,7 @@ def main():
                     "num_envs_per_worker": num_envs,
                     "obs_shape": [84, 84, 4],
                     "episode_reward_mean": round(reward, 3),
+                    "obs_transfer_MBps": obs_transfer,
                     "sac_pendulum": sac,
                 }
         )
@@ -137,6 +140,41 @@ def main():
         algo.stop()
     finally:
         ray_tpu.shutdown()
+
+
+def _bench_obs_transfer(batch_size):
+    """Rollout→learner obs-batch transfer rate, host plane vs device tier.
+
+    The PPO iteration moves one ``(B, 84, 84, 4)`` uint8 obs batch from the
+    rollout side to the learner every train() call; this times exactly that
+    movement as a cross-process put+get pair under both tiers and reports
+    MB/s for each plus the quotient (core/DEVICE_TIER.md)."""
+    import ray_tpu
+
+    obs = np.random.default_rng(3).integers(
+        0, 256, (batch_size, 84, 84, 4), dtype=np.uint8
+    )
+    mb = obs.nbytes / (1024 * 1024)
+
+    @ray_tpu.remote
+    def consume(x):
+        a = np.asarray(x)
+        return int(a[::17, 0, 0, 0].astype(np.int64).sum())
+
+    want = int(obs[::17, 0, 0, 0].astype(np.int64).sum())
+    out = {}
+    for label, tier in (("host", "host"), ("device", "device")):
+        # warm the pull path, then keep the best of 3 (same-box quotient)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.time()
+            ref = ray_tpu.put(obs, tier=tier)
+            got = ray_tpu.get(consume.remote(ref), timeout=300)
+            best = max(best, mb / (time.time() - t0))
+            assert got == want, f"obs transfer corrupted on {tier} tier"
+        out[label] = round(best, 1)
+    out["speedup"] = round(out["device"] / max(out["host"], 1e-9), 2)
+    return out
 
 
 def _bench_sac():

@@ -90,7 +90,14 @@ _CONFIG_DEF: Dict[str, tuple] = {
     # -- collective / tpu --
     "collective_rendezvous_timeout_s": (float, 120.0, "GCS-KV rendezvous wait"),
     "dcn_allreduce_chunk_bytes": (int, 4 * 1024 * 1024, "ring-allreduce chunk over DCN"),
+    "collective_socket_buffer_bytes": (int, 4 * 1024 * 1024, "SO_SNDBUF/SO_RCVBUF for dcn ring, p2p, and device-transfer sockets; 0 keeps the kernel default (small defaults are what capped the obs path at ~20MB/s)"),
     "tpu_slice_resource_name": (str, "TPU", "resource key for tpu chips"),
+    # -- device-resident object tier (core/DEVICE_TIER.md) --
+    "device_tier_enabled": (bool, True, "route put() of large device arrays through the device tier (pin in place, collective transfer) instead of shm"),
+    "device_tier_min_bytes": (int, 1 << 20, "auto-route a top-level jax.Array put through the device tier at/above this size; smaller arrays keep the host path (explicit tier='device' overrides)"),
+    "device_store_capacity": (int, 256 * 1024 * 1024, "per-process device-store budget before LRU entries spill to shm (then disk via the shm spill path)"),
+    "device_pull_fanout": (int, 2, "max concurrent collective pulls the head directs at one device holder; extra consumers park until a pull completes or a fresh holder registers — the binomial-tree fan-out for one-producer-many-consumer broadcast"),
+    "device_transfer_chunk_bytes": (int, 1 << 20, "per-syscall bound for device-tier sends (pipelined chunks from the pinned buffer; no full-array materialization)"),
     # -- logging / metrics --
     "event_loop_lag_warn_ms": (int, 500, "warn if the control loop stalls"),
     "metrics_report_period_ms": (int, 2000, "metrics push period"),

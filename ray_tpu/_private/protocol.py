@@ -197,6 +197,13 @@ class MsgType(enum.IntEnum):
     # (reference analog: HandleNotifyGCSRestart, node_manager.cc:1161)
     REATTACH = 114
 
+    # device-resident object tier (core/DEVICE_TIER.md): head → holder
+    # push telling a worker to drop its device-store entries for freed /
+    # out-of-scope object ids (the device-plane analog of OBJECT_DELETE,
+    # which only reaches raylets — device holders are WORKER processes,
+    # so the free fan-out rides their head conns).  Fire-and-forget.
+    DEVICE_FREE = 115
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

@@ -237,6 +237,107 @@ def main():
     print(json.dumps({k: round(v, 1) for k, v in results.items()}))
     ray_tpu.shutdown()
 
+    device_tier_rows(results)
+    print(json.dumps({k: round(v, 1) for k, v in results.items()}))
+
+
+def device_tier_rows(results):
+    """Object-plane transfer pair (core/DEVICE_TIER.md): the same arrays
+    moved producer→consumer over the classic host path (serialize → shm →
+    object-chunk TCP → shm → deserialize) vs the device tier (pinned at
+    the producer, typed pipelined pull over the collective plane).  Runs
+    on a multi-node in-one-machine Cluster so the host baseline transits
+    the REAL cross-node transfer agent, not a same-store shortcut; the
+    broadcast pair puts each consumer on its OWN node for the same reason
+    (co-resident consumers would share one pulled shm copy)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    MB = 1024 * 1024
+    fanout = 4
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for i in range(fanout):
+        c.add_node(num_cpus=2, resources={f"away{i}": 2.0})
+    ray_tpu.init(
+        address=c.address,
+        # the pairs below keep ~4 large arrays alive at once; eviction
+        # mid-row would measure the spill ladder, not the transfer plane
+        _system_config={"device_store_capacity": 2 * 1024 * MB},
+    )
+
+    @ray_tpu.remote
+    def consume(x):
+        a = np.asarray(x)
+        return int(a[:: max(1, a.size // 64)].sum())
+
+    try:
+        obs = np.random.default_rng(0).integers(
+            0, 255, size=90 * MB, dtype=np.uint8
+        )
+
+        def xfer(tier):
+            t0 = time.perf_counter()
+            ref = ray_tpu.put(obs, tier=tier)
+            ray_tpu.get(
+                consume.options(resources={"away0": 1.0}).remote(ref),
+                timeout=600,
+            )
+            return (obs.nbytes / MB) / (time.perf_counter() - t0)
+
+        pair = {}
+        for tier, label in (
+            ("host", "obs transfer 90MB (host)"),
+            ("device", "obs transfer 90MB (device tier)"),
+        ):
+            xfer(tier)  # warm the pool + the per-tier code path
+            pair[tier] = max(xfer(tier) for _ in range(3))
+            results[label] = pair[tier]
+            print(f"{label}: {pair[tier]:,.1f} MB/s")
+        results["obs transfer device vs host speedup"] = pair["device"] / pair["host"]
+        print(
+            f"obs transfer device vs host speedup: "
+            f"{pair['device'] / pair['host']:.1f}x"
+        )
+
+        # one producer, `fanout` consumers on distinct nodes pulling the
+        # SAME object concurrently.  Host: every node pulls from the
+        # producer's transfer agent.  Tree: consumers that finish re-serve
+        # their subtree (device_pull_fanout), so aggregate bandwidth
+        # scales past the producer's single uplink.
+        bcast = np.random.default_rng(1).integers(
+            0, 255, size=100 * MB, dtype=np.uint8
+        )
+
+        def broadcast(tier):
+            ref = ray_tpu.put(bcast, tier=tier)
+            t0 = time.perf_counter()
+            ray_tpu.get(
+                [
+                    consume.options(resources={f"away{i}": 1.0}).remote(ref)
+                    for i in range(fanout)
+                ],
+                timeout=600,
+            )
+            return (fanout * bcast.nbytes / MB) / (time.perf_counter() - t0)
+
+        bpair = {}
+        for tier, label in (
+            ("host", "broadcast 100MB x4 (host)"),
+            ("device", "broadcast 100MB x4 (tree)"),
+        ):
+            broadcast(tier)  # warm
+            bpair[tier] = max(broadcast(tier) for _ in range(2))
+            results[label] = bpair[tier]
+            print(f"{label}: {bpair[tier]:,.1f} MB/s aggregate")
+        results["broadcast tree vs host speedup"] = bpair["device"] / bpair["host"]
+        print(
+            f"broadcast tree vs host speedup: "
+            f"{bpair['device'] / bpair['host']:.1f}x"
+        )
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
 
 if __name__ == "__main__":
     main()

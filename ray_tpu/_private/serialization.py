@@ -25,6 +25,14 @@ META_PICKLE = b"py"
 META_RAW = b"raw"  # value is raw bytes, stored as-is, zero-copy
 META_TASK_ERROR = b"err"
 META_ACTOR_HANDLE = b"actor"
+# device-tier envelope (core/DEVICE_TIER.md): the HOST-side form of a
+# device-resident array — written only when a device object leaves the
+# device plane (LRU spill device→shm, or a host-fallback fetch).  inband
+# is a msgpack [kind, dtype_str, shape] header; buffers[0] is the raw
+# array image.  Refs stay ordinary ObjectRefs: a consumer that finds this
+# envelope in shm re-materializes the array without knowing it ever
+# lived on a device.
+META_DEVICE = b"dev"
 
 _jax_reducer_installed = False
 
@@ -160,10 +168,41 @@ def serialize(value: Any) -> SerializedObject:
     return SerializedObject(META_PICKLE, inband, views, contained)
 
 
+def serialize_device_payload(host_view, kind: str, dtype_str: str, shape) -> SerializedObject:
+    """Build the META_DEVICE envelope for a device array's host image.
+
+    ``host_view`` is a contiguous byte view of the array (NOT copied here
+    — put_serialized streams it into shm directly); ``kind`` records what
+    to rebuild on read ("jax" or "np") so a get() after spill is
+    bit-and-type-identical to a device-plane get."""
+    import msgpack
+
+    header = msgpack.packb([kind, dtype_str, list(shape)], use_bin_type=True)
+    return SerializedObject(META_DEVICE, header, [memoryview(host_view).cast("B")])
+
+
+def deserialize_device_payload(obj: SerializedObject) -> Any:
+    """Re-materialize a device array from its META_DEVICE envelope."""
+    import msgpack
+
+    kind, dtype_str, shape = msgpack.unpackb(obj.inband, raw=False)
+    buf = obj.buffers[0] if obj.buffers else b""
+    arr = np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+    if kind == "jax":
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+    # numpy path: the frombuffer view is read-only over a store view whose
+    # pin dies with the SerializedObject — hand back an owning copy
+    return np.array(arr)
+
+
 def deserialize(obj: SerializedObject) -> Any:
     _maybe_install_jax_reducer()
     if obj.metadata == META_RAW:
         return bytes(obj.buffers[0]) if obj.buffers else b""
+    if obj.metadata == META_DEVICE:
+        return deserialize_device_payload(obj)
     value = pickle.loads(obj.inband, buffers=obj.buffers)
     return value
 

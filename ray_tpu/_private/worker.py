@@ -274,11 +274,17 @@ def get(
     raise TypeError(f"cannot get() {type(refs)}")
 
 
-def put(value: Any) -> ObjectRef:
+def put(value: Any, *, tier: Optional[str] = None) -> ObjectRef:
+    """``tier``: None (auto — large jax.Array puts ride the device tier
+    when enabled, see core/DEVICE_TIER.md), "device" (pin any top-level
+    array in place; gets resolve zero-copy same-process and over the
+    collective plane cross-process), or "host" (force serialize→shm)."""
     cw = _require_connected()
     if isinstance(value, ObjectRef):
         raise TypeError("put() of an ObjectRef is not allowed (reference parity)")
-    return cw.put(value)
+    if tier not in (None, "device", "host"):
+        raise ValueError(f"tier must be None, 'device', or 'host', got {tier!r}")
+    return cw.put(value, tier=tier)
 
 
 def wait(

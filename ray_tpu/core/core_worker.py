@@ -331,6 +331,15 @@ class CoreWorker:
         # registration; None means everything routes to the head
         self._shard_conn: Optional[Connection] = None
 
+        # --- device-resident object tier (core/DEVICE_TIER.md) ---
+        # created lazily on the first device-tier put (or pull-cache):
+        # DeviceStore pins live arrays in place; DeviceTransferServer
+        # serves collective pulls from them.  None until then — the host
+        # path never pays for the tier it isn't using.
+        self.device_store = None
+        self._device_server = None
+        self._device_lock = named_lock("CoreWorker._device_lock")
+
         self.is_client = False  # remote driver without a local store mmap
         self._client_promoted: set = set()
         self._conn_lost = False
@@ -543,6 +552,13 @@ class CoreWorker:
                     # the head wants a cached lease back (preemption):
                     # stop pushing, drain, return
                     self._on_lease_revoke(payload)
+                elif msg_type == MsgType.DEVICE_FREE:
+                    # head push: drop device-store entries for freed /
+                    # out-of-scope objects (fire-and-forget, no reply)
+                    ds = self.device_store
+                    if ds is not None:
+                        for o in payload.get("object_ids", []):
+                            ds.delete(bytes(o))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             self._on_head_conn_lost(conn)
 
@@ -995,10 +1011,129 @@ class CoreWorker:
         )
         return ObjectID.for_put(task_id, idx).binary()
 
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, tier: Optional[str] = None) -> ObjectRef:
+        """``tier``: None (auto — large top-level jax.Array puts ride the
+        device tier when enabled), "device" (force: any jax.Array or
+        np.ndarray pins in place, never touching shm), or "host" (force
+        the classic serialize→shm path)."""
         oid = self._next_put_oid()
+        if tier != "host" and self.store is not None and RayConfig.device_tier_enabled:
+            from ray_tpu.core.device_store import classify_device_value
+
+            cls = classify_device_value(value)
+            if cls is not None:
+                kind, nbytes = cls
+                if tier == "device" or (
+                    tier is None
+                    and kind == "jax"
+                    and nbytes >= RayConfig.device_tier_min_bytes
+                ):
+                    self.put_device_object(oid, value, kind, nbytes)
+                    return ObjectRef(oid, self)
+            elif tier == "device":
+                raise TypeError(
+                    "tier='device' requires a top-level array value "
+                    f"(jax.Array or np.ndarray), got {type(value)!r}"
+                )
+        # client mode with tier='device' degrades to the host path: a
+        # storeless remote driver has no transfer plane to serve pulls from
         self.put_object(oid, serialization.serialize(value))
         return ObjectRef(oid, self)
+
+    # ------------------------------------------- device tier (put/pull side)
+
+    def _ensure_device_runtime(self):
+        """Device store + transfer server, created once per process on
+        first use.  The server must exist before the head learns we hold a
+        device object — its addr/token ride the registration."""
+        with self._device_lock:
+            if self.device_store is None:
+                from ray_tpu.core.device_store import (
+                    DeviceStore,
+                    DeviceTransferServer,
+                )
+
+                ds = DeviceStore()
+                ds.spill_fn = self._device_spill
+                self._device_server = DeviceTransferServer(ds)
+                self.device_store = ds
+            return self.device_store
+
+    def put_device_object(self, oid: bytes, value: Any, kind: str, nbytes: int):
+        """Pin `value` in the device store and register ONLY metadata at
+        the head: no copy to shm, no payload on the control plane.  The
+        head's directory gains a device-tier location (this process's
+        transfer addr + token) that consumers pull from collectively."""
+        ds = self._ensure_device_runtime()
+        meta = ds.put(oid, value, kind)
+        self.request(
+            MsgType.PUT_OBJECT,
+            {
+                "object_id": oid,
+                "node_id": self.node_id,
+                "contained": [],
+                "nbytes": meta["nbytes"],
+                "tier": "device",
+                "device_meta": meta,
+                "device_addr": self._device_server.addr,
+                "device_token": self._device_server.token,
+            },
+        )
+        self._device_event(
+            "device_put", object_id=oid.hex()[:16], nbytes=meta["nbytes"], kind=kind
+        )
+
+    def _device_spill(self, oid: bytes, entry) -> bool:
+        """Eviction handoff, first rung of the device→shm→disk ladder:
+        serialize the LRU victim into its META_DEVICE envelope in shm,
+        then re-seal at the head with tier="shm" so the directory drops
+        this process as a device holder and adds the shm location.  From
+        there the ordinary shm spill chain (spill_hook → disk) applies."""
+        from ray_tpu.core.device_store import host_image
+
+        env = serialization.serialize_device_payload(
+            host_image(entry), entry.kind, entry.dtype_str, entry.shape
+        )
+        self.store.put_serialized(oid, env)
+        self.request(
+            MsgType.PUT_OBJECT,
+            {
+                "object_id": oid,
+                "node_id": self.node_id,
+                "contained": [],
+                "nbytes": entry.nbytes,
+                "tier": "shm",
+                "device_evicted": True,
+                "device_addr": self._device_server.addr,
+            },
+        )
+        self._device_event(
+            "device_spill", object_id=oid.hex()[:16], nbytes=entry.nbytes
+        )
+        return True
+
+    def _device_event(self, message: str, **fields):
+        """Flight-recorder marker for a device-tier transfer (timeline
+        instant, source="device_tier").  Gated on the task-events flag —
+        the events-off path is stamp-free by contract."""
+        from ray_tpu._private import task_events
+
+        if not task_events.enabled:
+            return
+        try:
+            self.io.spawn(
+                self.conn.send(
+                    MsgType.RECORD_EVENT,
+                    {
+                        "severity": "INFO",
+                        "source": "device_tier",
+                        "message": message,
+                        "fields": {"node_id": bytes(self.node_id).hex()[:12], **fields},
+                    },
+                )
+            )
+        except Exception:  # graftlint: disable=silent-except -- telemetry marker is best-effort; a transfer must never fail on it
+            pass
 
     def put_object(self, oid: bytes, sobj: SerializedObject):
         # refs to memory-store-only values (direct-call results) must be
@@ -1140,6 +1275,13 @@ class CoreWorker:
                     self._resolve_direct(oid, deadline)
                 finally:
                     self._notify_blocked(False)
+            if self.device_store is not None:
+                dev = self.device_store.get(oid)
+                if dev is not None:
+                    # same-process device-tier hit: the LITERAL pinned
+                    # array, zero-copy — no bytes ever transit shm
+                    out[i] = dev
+                    continue
             sobj = self._memory_store.get(oid)
             if sobj is None and self.store is not None:
                 sobj = self.store.get_serialized(oid)
@@ -1222,7 +1364,14 @@ class CoreWorker:
                             *[
                                 self._head_request_parked(
                                     MsgType.WAIT_OBJECT,
-                                    {"object_id": oid, "timeout": rem, "node_id": self.node_id},
+                                    {
+                                        "object_id": oid,
+                                        "timeout": rem,
+                                        "node_id": self.node_id,
+                                        # we understand device-tier pull
+                                        # directives (collective plane)
+                                        "device_ok": True,
+                                    },
                                     (rem + 5) if rem is not None else 3600,
                                 )
                                 for _, oid in slow
@@ -1236,6 +1385,11 @@ class CoreWorker:
                             raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
                         if state == "error":
                             raise _error_from_string(reply.get("error", "task failed"))
+                        if reply.get("tier") == "device":
+                            # device-tier object: the head named a holder;
+                            # pull over the collective plane, not shm TCP
+                            out[i] = self._device_pull_value(oid, reply, deadline)
+                            continue
                         sobj = self.store.get_serialized(oid)
                         if sobj is None:
                             sobj = self._refetch_evicted(oid, deadline)
@@ -1274,6 +1428,112 @@ class CoreWorker:
             if sobj is not None:
                 return sobj
         raise ObjectLostError(oid.hex(), "sealed but repeatedly missing from local store")
+
+    def _device_pull_value(self, oid: bytes, reply: dict, deadline: Optional[float]) -> Any:
+        """Resolve a device-tier get: pull the typed array from the holder
+        the head named, cache it in OUR device store, and re-register as a
+        holder — which is what grows the broadcast tree (the next consumer
+        may be directed at us instead of the producer).  A failed pull
+        reports the dead address back (``device_failed``); the head prunes
+        that holder and redirects to a survivor, the shm envelope, or
+        lineage — or seals the typed error this raises."""
+        from ray_tpu.core.device_store import DevicePullError, pull_device_object
+
+        pull = reply.get("pull") or {}
+        for _ in range(4):
+            addr, token = pull.get("addr", ""), pull.get("token", "")
+            meta = pull.get("meta") or {}
+            rem = None if deadline is None else max(0.001, deadline - time.monotonic())
+            t0 = time.perf_counter()
+            try:
+                arr = pull_device_object(
+                    addr, token, oid, timeout=min(rem or 300.0, 300.0)
+                )
+            except DevicePullError as e:
+                logger.info(
+                    "device pull of %s from %s failed (%s); asking the head "
+                    "for another holder",
+                    oid.hex()[:16],
+                    addr,
+                    e,
+                )
+                reply = self.request(
+                    MsgType.WAIT_OBJECT,
+                    {
+                        "object_id": oid,
+                        "timeout": rem,
+                        "node_id": self.node_id,
+                        "device_ok": True,
+                        "device_failed": addr,
+                    },
+                    timeout=(rem + 5) if rem is not None else 3600,
+                )
+                state = reply.get("state")
+                if state == "timeout":
+                    raise GetTimeoutError(f"get() timed out on {oid.hex()[:16]}")
+                if state == "error":
+                    raise _error_from_string(reply.get("error", "object lost"))
+                if reply.get("tier") != "device":
+                    # the head fell back to the host plane (shm envelope /
+                    # restored spill / reconstruction): classic resolve
+                    sobj = self.store.get_serialized(oid)
+                    if sobj is None:
+                        sobj = self._refetch_evicted(oid, deadline)
+                    return self._materialize(sobj)
+                pull = reply.get("pull") or {}
+                continue
+            dt = time.perf_counter() - t0
+            value = self._rebuild_device_value(arr, meta)
+            self._device_cache_pulled(oid, value, meta, pulled_from=addr)
+            self._device_event(
+                "device_pull",
+                object_id=oid.hex()[:16],
+                src=addr,
+                nbytes=int(meta.get("nbytes", arr.nbytes)),
+                mbps=round((arr.nbytes / max(dt, 1e-9)) / 1e6, 1),
+            )
+            return value
+        raise ObjectLostError(
+            oid.hex(), "every device holder the head offered failed mid-pull"
+        )
+
+    @staticmethod
+    def _rebuild_device_value(arr, meta: dict) -> Any:
+        if meta.get("kind") == "jax":
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+        return arr
+
+    def _device_cache_pulled(self, oid: bytes, value: Any, meta: dict, pulled_from: str):
+        """Cache a pulled device object locally and announce ourselves as a
+        holder.  ``pulled_from`` releases the source's fan-out slot at the
+        head.  Best-effort: the VALUE is already in hand — a failed
+        registration only costs future consumers a shorter holder list."""
+        try:
+            ds = self._ensure_device_runtime()
+            ds.put(oid, value, meta.get("kind", "np"))
+            self.request(
+                MsgType.PUT_OBJECT,
+                {
+                    "object_id": oid,
+                    "node_id": self.node_id,
+                    "contained": [],
+                    "nbytes": int(meta.get("nbytes", 0)),
+                    "tier": "device",
+                    "device_meta": meta,
+                    "device_addr": self._device_server.addr,
+                    "device_token": self._device_server.token,
+                    "pulled_from": pulled_from,
+                },
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "device holder registration for %s failed; value resolved "
+                "but this process won't serve peers",
+                oid.hex()[:16],
+                exc_info=True,
+            )
 
     def _materialize(self, sobj: SerializedObject) -> Any:
         value = serialization.deserialize(sobj)
@@ -2765,4 +3025,11 @@ class CoreWorker:
                 self.store.close()
         except Exception:  # noqa: BLE001
             logger.debug("store close failed at disconnect", exc_info=True)
+        if self._device_server is not None:
+            try:
+                self._device_server.close()
+            except Exception:  # noqa: BLE001
+                logger.debug("device server close failed at disconnect", exc_info=True)
+            self._device_server = None
+            self.device_store = None
         self.io.stop()

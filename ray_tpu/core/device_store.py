@@ -1,0 +1,366 @@
+"""Device-resident object tier: pin arrays in place, move them over the
+collective transfer plane (core/DEVICE_TIER.md; ROADMAP item 3).
+
+The host object plane round-trips every ``put`` of a device array through
+device→host→shm (+TCP per hop on a cross-node get).  This module keeps the
+array where it already lives — HBM on TPU, device/host memory on the CPU
+backend — and records only METADATA at the head: dtype, shape, nbytes, and
+which processes hold a live copy (the Pathways discipline, PAPERS.md §2:
+accelerator-resident data, host off the transfer-critical path, layered
+onto the Ray object-store model, PAPERS.md §1).
+
+Three layers:
+
+- ``DeviceStore``: per-process registry oid → live array.  Same-process
+  ``get`` returns the LITERAL object (zero-copy identity, nothing through
+  shm).  Capacity-bounded: LRU entries hand off to shm as a META_DEVICE
+  envelope (serialization.py) via the ``spill_fn`` the core worker wires,
+  after which the ordinary shm→disk spill chain applies — the eviction
+  ladder is device → shm → disk, and a later get restores transparently.
+- ``DeviceTransferServer``: a plain-thread blocking-socket listener that
+  serves token-authenticated typed-array pulls straight from the pinned
+  buffer — dcn_backend framing (``send_array_frame``: fixed struct header,
+  never pickle), pipelined chunked sends, SO_SNDBUF/SO_RCVBUF sized
+  (``_configure_socket``).  Deliberately NOT on the io event loop: a 90MB
+  send must never stall heartbeats (graftsan GS001/GS002 contract).
+- ``pull_device_object``: the consumer half — one recv_into a
+  preallocated buffer; the returned array wraps it (one copy end to end,
+  vs ~5 full-payload copies on the host shm+chunk-TCP path).
+
+The head never proxies payload bytes: it directs a consumer at a named
+holder (addr + token), caps concurrent pulls per holder
+(``device_pull_fanout``) and registers each consumer's cached copy as a
+new holder — concurrent broadcast consumers therefore drain as a binomial
+tree growing one level per completed pull, the same fan-out shape as
+``DcnGroup._broadcast_tree``.
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private.config import RayConfig
+from ray_tpu.util.collective.dcn_backend import (
+    _configure_socket,
+    _recv_bounded_msg,
+    _self_ip,
+    _send_msg,
+    recv_array_frame,
+    send_array_frame,
+)
+from ray_tpu.util.lockwitness import named_lock
+
+logger = logging.getLogger(__name__)
+
+_HELLO_MAX = 4096
+
+
+class DevicePullError(ConnectionError):
+    """A collective pull from a named device holder failed (holder died,
+    evicted the entry, or the wire broke).  The caller reports the failed
+    address back to the head, which prunes the holder and falls back to a
+    surviving location / the shm envelope / lineage."""
+
+
+def classify_device_value(value) -> Optional[Tuple[str, int]]:
+    """(kind, nbytes) when `value` is a device-tier-able array: a
+    top-level jax.Array ("jax") or np.ndarray ("np" — on the CPU backend a
+    host array IS the device-resident buffer).  None for everything else:
+    containers keep the host pickle path (blast-radius control — refs stay
+    ordinary ObjectRefs either way)."""
+    import sys
+
+    if isinstance(value, np.ndarray):
+        return ("np", int(value.nbytes))
+    if "jax" in sys.modules:
+        import jax
+
+        if isinstance(value, jax.Array):
+            try:
+                nbytes = int(value.size) * int(value.dtype.itemsize)
+            except Exception:  # graftlint: disable=silent-except -- exotic dtypes (e.g. key arrays) fall back to the host path
+                return None
+            return ("jax", nbytes)
+    return None
+
+
+class _Entry:
+    __slots__ = ("value", "kind", "dtype_str", "shape", "nbytes", "pins", "last_used")
+
+    def __init__(self, value, kind: str, dtype_str: str, shape: tuple, nbytes: int):
+        self.value = value
+        self.kind = kind
+        self.dtype_str = dtype_str
+        self.shape = shape
+        self.nbytes = nbytes
+        self.pins = 0  # transfer serves in flight; pinned entries never evict
+        self.last_used = time.monotonic()
+
+
+class DeviceStore:
+    """Per-process device-object registry with LRU handoff to shm."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = named_lock("DeviceStore._lock")
+        self._entries: Dict[bytes, _Entry] = {}
+        self._bytes = 0
+        self.capacity = int(
+            capacity if capacity is not None else RayConfig.device_store_capacity
+        )
+        # wired by the core worker: (oid, entry) -> bool; serializes the
+        # entry into shm (META_DEVICE envelope) + re-seals at the head so
+        # the tier tag flips device→shm before the device copy drops
+        self.spill_fn: Optional[Callable[[bytes, "_Entry"], bool]] = None
+        self.evictions = 0
+
+    def put(self, oid: bytes, value, kind: str) -> dict:
+        """Register a live array; returns its wire meta.  May evict LRU
+        entries through spill_fn to stay under capacity (never the entry
+        being inserted)."""
+        arr_like = value
+        dtype_str = np.dtype(arr_like.dtype).str
+        shape = tuple(int(s) for s in arr_like.shape)
+        nbytes = (
+            int(value.nbytes)
+            if kind == "np"
+            else int(value.size) * int(value.dtype.itemsize)
+        )
+        with self._lock:
+            if oid in self._entries:
+                return self._meta_locked(self._entries[oid])
+            entry = _Entry(value, kind, dtype_str, shape, nbytes)
+            self._entries[oid] = entry
+            self._bytes += nbytes
+            victims = self._pick_victims_locked(exclude=oid)
+        for vid, ventry in victims:
+            self._spill_out(vid, ventry)
+        return {
+            "kind": kind,
+            "dtype": dtype_str,
+            "shape": list(shape),
+            "nbytes": nbytes,
+        }
+
+    def _meta_locked(self, e: _Entry) -> dict:
+        return {
+            "kind": e.kind,
+            "dtype": e.dtype_str,
+            "shape": list(e.shape),
+            "nbytes": e.nbytes,
+        }
+
+    def _pick_victims_locked(self, exclude: bytes) -> List[Tuple[bytes, _Entry]]:
+        if self._bytes <= self.capacity:
+            return []
+        victims = []
+        for vid, e in sorted(self._entries.items(), key=lambda kv: kv[1].last_used):
+            if self._bytes <= self.capacity:
+                break
+            if vid == exclude or e.pins > 0:
+                continue
+            victims.append((vid, e))
+            self._bytes -= e.nbytes
+            del self._entries[vid]
+        return victims
+
+    def _spill_out(self, oid: bytes, entry: _Entry):
+        self.evictions += 1
+        fn = self.spill_fn
+        if fn is None:
+            logger.warning(
+                "device store over capacity with no spill_fn; dropping %s "
+                "(%d bytes) — a later get needs lineage",
+                oid.hex()[:16],
+                entry.nbytes,
+            )
+            return
+        try:
+            fn(oid, entry)
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "device→shm spill of %s failed; the device copy is gone",
+                oid.hex()[:16],
+            )
+
+    def get(self, oid: bytes):
+        """The literal stored array, or None.  Zero-copy by definition —
+        no serialization, no shm, no socket."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return None
+            e.last_used = time.monotonic()
+            return e.value
+
+    def contains(self, oid: bytes) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def pin_for_serve(self, oid: bytes) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return None
+            e.pins += 1
+            e.last_used = time.monotonic()
+            return e
+
+    def unpin(self, oid: bytes):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    def delete(self, oid: bytes) -> bool:
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is None:
+                return False
+            self._bytes -= e.nbytes
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "objects": len(self._entries),
+                "bytes": self._bytes,
+                "capacity": self.capacity,
+                "evictions": self.evictions,
+            }
+
+
+def host_image(entry: _Entry) -> memoryview:
+    """Contiguous byte view of an entry's host image.  np entries export
+    their buffer directly (zero-copy for contiguous arrays); jax entries
+    pull to host once — on the CPU backend np.asarray is itself zero-copy
+    for an unsharded array."""
+    if entry.kind == "np":
+        arr = np.ascontiguousarray(entry.value)
+    else:
+        arr = np.ascontiguousarray(np.asarray(entry.value))
+    return memoryview(arr).cast("B")
+
+
+class DeviceTransferServer:
+    """Serves token-authenticated device-object pulls from this process.
+
+    One standing listener thread + one short-lived thread per admitted
+    pull (the head's ``device_pull_fanout`` bounds concurrency cluster-
+    wide; the local hard cap is a backstop against a misbehaving peer).
+    Hello frame (never unpickled): ``devpull\\n<token>\\n<oid hex>``; reply
+    ``ok`` + one typed-array frame, or ``err:<reason>``.
+    """
+
+    _MAX_SERVE_THREADS = 16
+
+    def __init__(self, store: DeviceStore):
+        self.store = store
+        self.token = secrets.token_hex(16)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(8)
+        port = self._listener.getsockname()[1]
+        import os
+
+        host = os.environ.get("RAY_TPU_NODE_IP") or _self_ip()
+        self.addr = f"{host}:{port}"
+        self._closed = False
+        self._serving = threading.Semaphore(self._MAX_SERVE_THREADS)
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="device-transfer", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self):
+        self._listener.settimeout(1.0)
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not self._serving.acquire(blocking=False):
+                sock.close()  # over the local backstop; the peer retries
+                continue
+            threading.Thread(
+                target=self._serve_one, args=(sock,), daemon=True
+            ).start()
+
+    def _serve_one(self, sock: socket.socket):
+        try:
+            _configure_socket(sock)
+            sock.settimeout(10)
+            parts = _recv_bounded_msg(sock, max_len=_HELLO_MAX).decode().split("\n")
+            if len(parts) != 3 or parts[0] != "devpull" or parts[1] != self.token:
+                sock.close()
+                return
+            oid = bytes.fromhex(parts[2])
+            entry = self.store.pin_for_serve(oid)
+            if entry is None:
+                _send_msg(sock, b"err:gone")
+                sock.close()
+                return
+            try:
+                view = host_image(entry)
+                sock.settimeout(600)
+                _send_msg(sock, b"ok")
+                send_array_frame(sock, entry.dtype_str, entry.shape, view)
+            finally:
+                self.store.unpin(oid)
+            sock.close()
+        except Exception:  # graftlint: disable=silent-except -- per-pull serve thread; a broken peer socket is the PULLER's error to surface (it retries against the head)
+            try:
+                sock.close()
+            except OSError:
+                pass
+        finally:
+            self._serving.release()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def pull_device_object(
+    addr: str, token: str, oid: bytes, timeout: float = 300.0
+) -> np.ndarray:
+    """Pull one device object from a named holder.  Raises DevicePullError
+    on any transport/auth/absence failure — the caller's cue to report
+    ``device_failed`` to the head and be redirected."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        sock = socket.create_connection((host, int(port)), timeout=10)
+    except OSError as e:
+        raise DevicePullError(f"dial {addr}: {e}") from e
+    try:
+        _configure_socket(sock)
+        sock.settimeout(timeout)
+        _send_msg(sock, f"devpull\n{token}\n{oid.hex()}".encode())
+        status = _recv_bounded_msg(sock, max_len=_HELLO_MAX)
+        if status != b"ok":
+            raise DevicePullError(
+                f"holder {addr} refused pull of {oid.hex()[:16]}: "
+                f"{status.decode(errors='replace')}"
+            )
+        return recv_array_frame(sock)
+    except DevicePullError:
+        raise
+    except (OSError, ConnectionError, TimeoutError) as e:
+        raise DevicePullError(f"pull from {addr} failed: {e}") from e
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

@@ -278,6 +278,27 @@ class ShmObjectStore:
             sobj._pin = region
         return sobj
 
+    def metadata_of(self, object_id: bytes) -> Optional[bytes]:
+        """Metadata tag of a sealed object without materializing inband or
+        buffers — a cheap tier probe (e.g. META_DEVICE envelopes written by
+        the device-store eviction ladder, core/DEVICE_TIER.md).  None if
+        absent/unsealed."""
+        self._check(object_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, object_id, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        try:
+            view = self._mv[off.value : off.value + size.value]
+            (hlen,) = _U32.unpack(view[: _U32.size])
+            metadata, _, _ = msgpack.unpackb(
+                bytes(view[_U32.size : _U32.size + hlen]), raw=False
+            )
+            return bytes(metadata)
+        finally:
+            self._lib.store_release(self._handle, object_id)
+
     # -- raw ops (object-transfer layer) --------------------------------------
 
     def raw_view(self, object_id: bytes) -> Optional[memoryview]:

@@ -419,6 +419,17 @@ class HeadServer:
         # object accounting sidecar: oid -> {"nbytes", "owner"} stamped at
         # seal time (owner derived from the sealing connection)
         self.object_meta: Dict[bytes, dict] = {}
+        # device-resident object tier (core/DEVICE_TIER.md): oid ->
+        # {"meta": {kind,dtype,shape,nbytes}, "holders": {addr: {"token",
+        # "cid", "conn", "node_id", "pulls": [time.time(), ...]}}}.
+        # Deliberately NOT WAL-persisted: device buffers die with their
+        # processes across a head restart, so a recovered head resolves
+        # these objects via shm envelopes or lineage instead.
+        self.device_objects: Dict[bytes, dict] = {}
+        # consumers parked because every live holder is at its
+        # device_pull_fanout cap; woken when a pull slot frees (pulled_from
+        # re-registration) or a new holder joins the fan-out tree
+        self._device_slot_waiters: Dict[bytes, List[asyncio.Future]] = {}
         # freshest rolling stats per train run (TRAIN_STEP frames)
         self.train_stats: Dict[str, dict] = {}
         # freshest DAG channel ring occupancy samples (DAG_STEP frames)
@@ -1673,6 +1684,9 @@ class HeadServer:
                     w, self.nodes.get(w.node_id), reason="holder disconnected"
                 )
         kind = self._conn_kind.pop(cid, None)
+        # device-tier holders served over this conn are gone with it
+        if kind in ("worker", "driver"):
+            self._device_drop_conn(cid)
         # ownership claims recorded for this conn die with it: a LATER
         # "late claim application" must never rebind an actor to a
         # vanished conn id (conn ids are not reused — that actor would
@@ -2224,23 +2238,209 @@ class HeadServer:
         nid = p.get("node_id")
         if nid is None:
             nid = self._conn_node.get(cid) or self.head_node_id
-        self._pin_contained(bytes(p["object_id"]), p.get("contained") or [])
-        self._record_object_meta(cid, bytes(p["object_id"]), p.get("nbytes"))
+        oid = bytes(p["object_id"])
+        tier = p.get("tier")
+        if tier == "device":
+            # metadata-only seal: the payload never left the producer's
+            # device store.  The directory gains a pull endpoint instead of
+            # a shm location (core/DEVICE_TIER.md).
+            self._device_register(cid, conn, nid, oid, p)
+            self._pin_contained(oid, p.get("contained") or [])
+            self._record_object_meta(cid, oid, p.get("nbytes"), tier="device")
+            await self._seal_object(oid)
+            return {"ok": True}
+        if p.get("device_evicted"):
+            # eviction handoff, device→shm rung: the sender spilled its
+            # device entry into a shm envelope — drop it as a holder so it
+            # is never offered a pull it can no longer serve, and let the
+            # shm location recorded below take over
+            self._device_drop_holder(oid, p.get("device_addr", ""))
+        self._pin_contained(oid, p.get("contained") or [])
+        self._record_object_meta(cid, oid, p.get("nbytes"))
         self._add_location(p["object_id"], nid)
         await self._seal_object(p["object_id"])
         return {"ok": True}
 
-    def _record_object_meta(self, cid: int, oid: bytes, nbytes) -> None:
+    def _record_object_meta(self, cid: int, oid: bytes, nbytes, tier: str = "shm") -> None:
         """Object-accounting sidecar for `ray-tpu summary memory`: who
         sealed it (derived from the sealing connection — workers by id,
-        drivers/clients by kind) and how big it was on the wire."""
+        drivers/clients by kind), how big it is, and which tier holds it.
+        Device-tier objects report their REAL array nbytes; an eviction
+        re-seal overwrites tier to "shm" so a spilled device object is
+        never counted in both tiers."""
         wid = self._conn_worker.get(cid)
         owner = (
             bytes(wid).hex()[:12]
             if wid
             else (self._conn_kind.get(cid) or "head")
         )
-        self.object_meta[oid] = {"owner": owner, "nbytes": int(nbytes or 0)}
+        self.object_meta[oid] = {
+            "owner": owner,
+            "nbytes": int(nbytes or 0),
+            "tier": tier,
+        }
+
+    # ----------------------------------------------------- device tier (head)
+
+    def _device_register(self, cid, conn, nid, oid: bytes, p: dict):
+        """Record/refresh a device holder.  First registration comes from
+        the producer's put; later ones from consumers that completed a
+        pull and now re-serve their subtree — that re-registration is what
+        grows the broadcast fan-out tree without the head ever building an
+        explicit tree."""
+        rec = self.device_objects.setdefault(
+            oid, {"meta": dict(p.get("device_meta") or {}), "holders": {}}
+        )
+        addr = str(p.get("device_addr") or "")
+        if addr:
+            rec["holders"][addr] = {
+                "token": str(p.get("device_token") or ""),
+                "cid": cid,
+                "conn": conn,
+                "node_id": bytes(nid) if nid else self.head_node_id,
+                "pulls": [],
+            }
+        src = p.get("pulled_from")
+        if src:
+            h = rec["holders"].get(str(src))
+            if h is not None and h["pulls"]:
+                h["pulls"].pop(0)  # release the fan-out slot this pull held
+        self._device_wake(oid)
+
+    def _device_drop_holder(self, oid: bytes, addr: str, failed: bool = False):
+        rec = self.device_objects.get(oid)
+        if rec is None:
+            return
+        h = rec["holders"].pop(addr, None)
+        if h is not None and failed:
+            self._record_event(
+                "WARNING",
+                "device_tier",
+                f"device holder {addr} for {oid.hex()[:16]} failed mid-pull",
+            )
+        if not rec["holders"]:
+            self.device_objects.pop(oid, None)
+        self._device_wake(oid)
+
+    def _device_drop_conn(self, cid: int):
+        """A worker/driver conn died: every holder endpoint it served is
+        gone.  Parked pullers wake and either find a surviving holder or
+        fall back to the host plane (shm envelope / spill / lineage)."""
+        for oid in list(self.device_objects):
+            rec = self.device_objects.get(oid)
+            if rec is None:
+                continue
+            dead = [a for a, h in rec["holders"].items() if h["cid"] == cid]
+            for addr in dead:
+                rec["holders"].pop(addr, None)
+            if dead and not rec["holders"]:
+                self.device_objects.pop(oid, None)
+            if dead:
+                self._device_wake(oid)
+
+    def _device_wake(self, oid: bytes):
+        for fut in self._device_slot_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    def _device_pick_holder(self, oid: bytes) -> Optional[str]:
+        """Least-loaded live holder with a free fan-out slot, or None.
+        Pull slots decay after 120s — a consumer that died mid-pull (its
+        pulled_from release never arrives) must not park the object's
+        fan-out forever."""
+        rec = self.device_objects.get(oid)
+        if not rec:
+            return None
+        now = time.time()
+        fanout = max(1, RayConfig.device_pull_fanout)
+        best, best_n = None, None
+        for addr, h in rec["holders"].items():
+            h["pulls"] = [t for t in h["pulls"] if now - t < 120.0]
+            n = len(h["pulls"])
+            if n < fanout and (best_n is None or n < best_n):
+                best, best_n = addr, n
+        return best
+
+    async def _device_directive(
+        self, oid: bytes, deadline: Optional[float]
+    ) -> Optional[dict]:
+        """Resolve a device-tier wait into a pull directive
+        ({"state":"sealed","tier":"device","pull":{addr,token,meta}}), or
+        None when no holder survives (caller falls back to the host
+        plane), or a timeout reply.  When every holder is saturated the
+        waiter parks until a slot frees or a new holder joins the tree."""
+        while True:
+            rec = self.device_objects.get(oid)
+            if not rec or not rec["holders"]:
+                return None
+            addr = self._device_pick_holder(oid)
+            if addr is not None:
+                h = rec["holders"][addr]
+                h["pulls"].append(time.time())
+                return {
+                    "state": "sealed",
+                    "tier": "device",
+                    "pull": {"addr": addr, "token": h["token"], "meta": rec["meta"]},
+                }
+            fut = asyncio.get_running_loop().create_future()
+            self._device_slot_waiters.setdefault(oid, []).append(fut)
+            rem = None if deadline is None else max(0.001, deadline - time.time())
+            try:
+                # 1s re-poll backstop: slot decay (dead puller) isn't an
+                # event, so a parked waiter must re-evaluate periodically
+                await asyncio.wait_for(fut, min(rem, 1.0) if rem is not None else 1.0)
+            except asyncio.TimeoutError:
+                if deadline is not None and time.time() >= deadline:
+                    return {"state": "timeout"}
+            finally:
+                lst = self._device_slot_waiters.get(oid)
+                if lst is not None:
+                    try:
+                        lst.remove(fut)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        self._device_slot_waiters.pop(oid, None)
+
+    async def _device_fetch_to_head(self, oid: bytes) -> Optional[str]:
+        """Materialize a device-tier object into the HEAD's shm store as a
+        META_DEVICE envelope (client-mode gets: the remote driver has no
+        transfer plane, so the head pulls on its behalf).  Returns None on
+        success, else an error string."""
+        from ray_tpu._private.serialization import serialize_device_payload
+        from ray_tpu.core.device_store import DevicePullError, pull_device_object
+
+        while True:
+            rec = self.device_objects.get(oid)
+            if not rec or not rec["holders"]:
+                return f"ObjectLostError: no live device holder for {oid.hex()[:16]}"
+            addr = next(iter(rec["holders"]))
+            h = rec["holders"][addr]
+            meta = rec["meta"]
+
+            def _pull():
+                arr = pull_device_object(addr, h["token"], oid, timeout=300)
+                env = serialize_device_payload(
+                    memoryview(arr).cast("B"),
+                    meta.get("kind", "np"),
+                    meta.get("dtype", str(arr.dtype)),
+                    meta.get("shape", list(arr.shape)),
+                )
+                self._store.put_serialized(oid, env)
+
+            try:
+                await asyncio.get_running_loop().run_in_executor(None, _pull)
+            except DevicePullError as e:
+                logger.info(
+                    "head-side device pull of %s from %s failed: %s",
+                    oid.hex()[:16],
+                    addr,
+                    e,
+                )
+                self._device_drop_holder(oid, addr, failed=True)
+                continue
+            self._add_location(oid, self.head_node_id)
+            return None
 
     def _pin_contained(self, oid: bytes, contained: List[bytes]):
         """Pin the refs pickled inside a stored object for the container's
@@ -2398,6 +2598,11 @@ class HeadServer:
         timeout = p.get("timeout")
         deadline = time.time() + timeout if timeout is not None else None
         dest_nid = bytes(p["node_id"]) if p.get("node_id") is not None else None
+        if p.get("device_failed"):
+            # the consumer's pull from this holder died: prune it so nobody
+            # else is directed at a dead endpoint, then re-resolve below —
+            # a surviving holder, the shm envelope, or lineage
+            self._device_drop_holder(oid, str(p["device_failed"]), failed=True)
         if p.get("evicted") and dest_nid is not None:
             # client found the object missing from its local store after a
             # sealed reply: that location is stale (LRU-evicted)
@@ -2420,6 +2625,24 @@ class HeadServer:
             e = self.objects[oid]
             if e[0] == ERRORED:
                 return {"state": "error", "error": e[1]}
+            if oid in self.device_objects and dest_nid is not None:
+                if p.get("device_ok"):
+                    # a pull-capable waiter gets the directive even when it
+                    # shares the head's node — the collective plane beats a
+                    # head-mediated envelope copy there too
+                    directive = await self._device_directive(bytes(oid), deadline)
+                    if directive is not None:
+                        return directive
+                else:
+                    # destination can't pull (the head itself in client-mode
+                    # gets, or a waiter that predates the device protocol):
+                    # materialize a META_DEVICE envelope into the head store
+                    # and let the classic host plane below serve it onward
+                    derr = await self._device_fetch_to_head(bytes(oid))
+                    if derr is None and dest_nid == self.head_node_id:
+                        return {"state": "sealed"}
+                # holders gone (or envelope now head-local): fall through to
+                # the host plane — shm locations, spill restore, or lineage
             if dest_nid is None:
                 return {"state": "sealed"}
             # cross-node data plane: fetch the object onto the waiter's node
@@ -2503,7 +2726,21 @@ class HeadServer:
 
     def _delete_everywhere(self, oid: bytes):
         """Drop all copies: head store directly, remote nodes by directive
-        (including any spill file)."""
+        (including any spill file), and device-store pins by DEVICE_FREE
+        push to every holder (fire-and-forget — a holder that misses the
+        push only over-pins until its process exits)."""
+        rec = self.device_objects.pop(bytes(oid), None)
+        if rec:
+            self._device_wake(bytes(oid))
+            pushed = set()
+            for h in rec["holders"].values():
+                c = h.get("conn")
+                if c is None or id(c) in pushed:
+                    continue
+                pushed.add(id(c))
+                asyncio.get_running_loop().create_task(
+                    c.send(MsgType.DEVICE_FREE, {"object_ids": [bytes(oid)]})
+                )
         locs = self.object_locations.pop(oid, set())
         self._wal("obj-", bytes(oid))
         for nid in locs:
@@ -3921,6 +4158,7 @@ class HeadServer:
             key = {PENDING: "PENDING", SEALED: "SEALED", ERRORED: "ERRORED"}[entry[0]]
             by_state[key] += 1
         by_owner: Dict[str, dict] = {}
+        by_tier: Dict[str, dict] = {}
         for oid, meta in self.object_meta.items():
             if oid not in self.objects:
                 continue
@@ -3929,16 +4167,35 @@ class HeadServer:
             )
             slot["count"] += 1
             slot["bytes"] += int(meta.get("nbytes", 0))
+            # tier accounting: a device object that spilled was re-sealed
+            # with tier="shm", so it lands in exactly one bucket here
+            tslot = by_tier.setdefault(
+                meta.get("tier", "shm"), {"count": 0, "bytes": 0}
+            )
+            tslot["count"] += 1
+            tslot["bytes"] += int(meta.get("nbytes", 0))
         pinned = sum(1 for c in self.object_refcounts.values() if c > 0)
+        device_holders = sum(
+            len(r["holders"]) for r in self.device_objects.values()
+        )
         return {
             "nodes": nodes,
             "objects": {
                 "by_state": by_state,
                 "by_owner": by_owner,
+                "by_tier": by_tier,
                 "pinned": pinned,
                 "total": len(self.objects),
                 "spilled": len(self.object_spilled),
                 "lineage": len(self.lineage),
+            },
+            "device_tier": {
+                "objects": len(self.device_objects),
+                "bytes": sum(
+                    int(r["meta"].get("nbytes", 0))
+                    for r in self.device_objects.values()
+                ),
+                "holders": device_holders,
             },
             "dag_channels": {k: dict(v) for k, v in self.dag_channel_stats.items()},
             # per-deployment paged-KV pool occupancy (the engine's HBM
@@ -5682,6 +5939,21 @@ class HeadServer:
             "Objects whose only durable copy is a spill file",
             {},
             len(self.object_spilled),
+        )
+        self._set_gauge(
+            "ray_tpu_device_object_count",
+            "Objects resident in the device tier (HBM-pinned, zero shm copy)",
+            {},
+            len(self.device_objects),
+        )
+        self._set_gauge(
+            "ray_tpu_device_object_bytes",
+            "Array bytes pinned in the device tier across all holders",
+            {},
+            sum(
+                int(r["meta"].get("nbytes", 0))
+                for r in self.device_objects.values()
+            ),
         )
 
     def _slo_metrics_view(self) -> Dict[str, dict]:

@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.sample_batch import ADVANTAGES, SampleBatch
+from ray_tpu.rllib.sample_batch import ADVANTAGES, OBS, SampleBatch
 
 
 @dataclass
@@ -169,18 +169,51 @@ class PPO(Algorithm):
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         t0 = time.time()
-        # broadcast current weights, then sample all workers in parallel
-        weights_ref = ray_tpu.put(self.policy.get_weights())
-        ray_tpu.get([w.set_weights.remote(weights_ref) for w in self.workers], timeout=300)
+        # broadcast current weights, then sample all workers in parallel.
+        # When the device object tier is on, the weights go out as ONE flat
+        # jax vector pinned in learner HBM — workers pull it over the
+        # collective plane (emergent broadcast tree) instead of the host
+        # object path re-serializing the pytree per worker.
+        from ray_tpu._private.config import RayConfig
+
+        if RayConfig.device_tier_enabled:
+            weights_ref = ray_tpu.put(
+                self.policy.get_flat_weights(), tier="device"
+            )
+            ray_tpu.get(
+                [w.set_flat_weights.remote(weights_ref) for w in self.workers],
+                timeout=300,
+            )
+        else:
+            weights_ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get(
+                [w.set_weights.remote(weights_ref) for w in self.workers],
+                timeout=300,
+            )
         steps_per_worker = max(
             cfg.rollout_fragment_length, cfg.train_batch_size // max(len(self.workers), 1)
         )
         # sample() takes PER-ENV steps; a vector env contributes
         # num_envs rows per step
         per_env = max(1, -(-steps_per_worker // cfg.num_envs_per_worker))
-        batches = ray_tpu.get(
-            [w.sample.remote(per_env) for w in self.workers], timeout=600
-        )
+        if RayConfig.device_tier_enabled:
+            # obs rides the device tier: each worker pins its [T*N,84,84,4]
+            # block locally and returns a ref; the learner pulls all blocks
+            # over the collective plane instead of the task-reply host path
+            pairs = ray_tpu.get(
+                [w.sample_as_ref.remote(per_env) for w in self.workers],
+                timeout=600,
+            )
+            batches = []
+            for rest, obs_ref in pairs:
+                b = SampleBatch(dict(rest))
+                if obs_ref is not None:
+                    b[OBS] = np.asarray(ray_tpu.get(obs_ref, timeout=300))
+                batches.append(b)
+        else:
+            batches = ray_tpu.get(
+                [w.sample.remote(per_env) for w in self.workers], timeout=600
+            )
         batch = SampleBatch.concat_samples(batches)
         # advantage normalization (reference: ppo standardize_fields)
         adv = batch[ADVANTAGES]

@@ -125,8 +125,16 @@ class ES(Algorithm):
         pairs = max(1, cfg.population // 2)
         seeds = [int(s) for s in self._seed_rng.integers(0, 2**31 - 1, pairs)]
         # theta ships ONCE per iteration (the broadcast pattern PPO uses
-        # for weights), not re-pickled into each of the 2*pairs tasks
-        theta_ref = ray_tpu.put(self.theta)
+        # for weights), not re-pickled into each of the 2*pairs tasks; when
+        # the device tier is on it is pinned in place and the evaluator
+        # fan-out pulls it over the collective plane (one-producer-many-
+        # consumer is exactly the emergent broadcast tree's shape)
+        from ray_tpu._private.config import RayConfig
+
+        if RayConfig.device_tier_enabled:
+            theta_ref = ray_tpu.put(np.ascontiguousarray(self.theta), tier="device")
+        else:
+            theta_ref = ray_tpu.put(self.theta)
         refs = []
         for s in seeds:
             for sign in (1.0, -1.0):

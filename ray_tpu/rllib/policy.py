@@ -460,6 +460,30 @@ class JaxPolicy:
 
         self.params = jax.tree.map(jnp.asarray, weights)
 
+    def get_flat_weights(self):
+        """Policy weights as ONE contiguous jax vector.
+
+        A single array (instead of the get_weights pytree of host copies)
+        is what the device object tier pins in place: the learner puts the
+        vector with ``tier="device"`` and every rollout worker pulls it
+        over the collective plane, no host serialization of the tree."""
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(self.params)
+        self._unravel_weights = unravel
+        return flat
+
+    def set_flat_weights(self, flat):
+        """Inverse of get_flat_weights: rebuild params from a flat vector
+        (jax or numpy) using this policy's own tree structure."""
+        import jax.numpy as jnp
+
+        if getattr(self, "_unravel_weights", None) is None:
+            from jax.flatten_util import ravel_pytree
+
+            _, self._unravel_weights = ravel_pytree(self.params)
+        self.params = self._unravel_weights(jnp.asarray(flat))
+
     def get_state(self):
         """Full learner state (params + optimizer moments) for
         Algorithm.save checkpoints."""

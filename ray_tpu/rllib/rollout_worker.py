@@ -171,6 +171,29 @@ class RolloutWorker:
         self.policy.set_weights(weights)
         return True
 
+    def set_flat_weights(self, flat):
+        """Device-tier weight sync: the learner broadcasts ONE flat vector
+        (pinned in its HBM, pulled here over the collective plane) and the
+        worker unravels it into its own param tree."""
+        self.policy.set_flat_weights(flat)
+        return True
+
+    def sample_as_ref(self, num_steps: int):
+        """sample(), but the [T*N, ...] OBS block — by far the heaviest
+        column — is returned as a device-tier object ref instead of rows
+        in the reply payload, so the learner pulls it over the collective
+        plane.  The remaining (small) columns travel inline.  Falls back
+        to a plain inline batch when the device tier is off."""
+        import ray_tpu
+        from ray_tpu._private.config import RayConfig
+
+        batch = self.sample(num_steps)
+        if not RayConfig.device_tier_enabled:
+            return dict(batch), None
+        obs = np.ascontiguousarray(batch[OBS])
+        rest = {k: v for k, v in batch.items() if k != OBS}
+        return rest, ray_tpu.put(obs, tier="device")
+
     def get_weights(self):
         return self.policy.get_weights()
 

@@ -147,6 +147,11 @@ class InferenceEngine:
         # parked defrag requests, executed by the loop at iteration
         # boundaries (see defrag())
         self._defrag_reqs: List = []
+        # staged weight hot-swap (update_weights): applied atomically at
+        # the next iteration boundary so no prefill/decode program ever
+        # sees a half-swapped tree
+        self._pending_params = None
+        self.weight_updates = 0
         self._wake = threading.Event()
         self._stop = False
         self._fatal: Optional[str] = None
@@ -248,10 +253,51 @@ class InferenceEngine:
                 done.set()
             self._maybe_gauges(force=True)
 
+    def update_weights(self, params=None, *, ref=None) -> None:
+        """Stage a live weight hot-swap; applied at the next iteration
+        boundary (decode never sees a half-swapped tree).
+
+        ``params`` is a pytree matching ``llm.params`` OR a flat 1-D
+        vector (``ravel_pytree`` order — what a trainer broadcasts through
+        the device object tier).  ``ref`` is an ObjectRef to either form:
+        resolving it here means a device-tier ref lands zero-copy when the
+        trainer shares this process/mesh, and rides the collective pull
+        plane cross-node — the host object path never re-serializes the
+        checkpoint (core/DEVICE_TIER.md)."""
+        if (params is None) == (ref is None):
+            raise ValueError("update_weights wants exactly one of params=/ref=")
+        if ref is not None:
+            import ray_tpu
+
+            params = ray_tpu.get(ref, timeout=300)
+        import jax
+        import jax.numpy as jnp
+
+        if hasattr(params, "ndim") and getattr(params, "ndim") == 1:
+            # flat vector → this model's own tree structure
+            from jax.flatten_util import ravel_pytree
+
+            _, unravel = ravel_pytree(self.llm.params)
+            new = unravel(jnp.asarray(params))
+        else:
+            new = jax.tree.map(jnp.asarray, params)
+        with self._lock:
+            self._pending_params = new
+        self._wake.set()
+
+    def _apply_pending_params(self) -> None:
+        with self._lock:
+            new, self._pending_params = self._pending_params, None
+        if new is None:
+            return
+        self.llm.params = new
+        self.weight_updates += 1
+
     def _iteration(self) -> None:
         from ray_tpu.serve import tracing as serve_tracing
 
         self.iterations += 1
+        self._apply_pending_params()
         self._run_defrags()
         with self._lock:
             self._reap_cancelled()

@@ -158,10 +158,14 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp 
     return g.reduce(tensor, dst_rank, op)
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default", topology: str = "ring"):
+    """``topology`` applies to dcn groups: "ring" (n-1 serial hops) or
+    "tree" (binomial fan-out over p2p links, O(log n) depth — internal
+    ranks re-serve their subtree, so aggregate bandwidth scales past the
+    source's single uplink).  ICI groups ignore it (XLA schedules)."""
     g = _manager.get(group_name)
     if hasattr(g, "rank"):
-        return g.broadcast(_to_numpy(tensor), src_rank)
+        return g.broadcast(_to_numpy(tensor), src_rank, topology=topology)
     return g.broadcast(tensor, src_rank)
 
 

@@ -39,6 +39,70 @@ _U8 = struct.Struct("<B")
 _IO_CHUNK = 1 << 20  # bounded per-syscall transfer so send/recv interleave
 
 
+def _configure_socket(sock: socket.socket) -> None:
+    """Data-plane socket tuning: NODELAY (frame latency) + kernel buffer
+    sizing from config.  The default 128-208KB SO_SNDBUF is what capped
+    the p2p obs path around ~20MB/s — each sendall round-trips the
+    application once per buffer-full; multi-MB buffers let the kernel
+    stream a whole pipelined window per wakeup."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        from ray_tpu._private.config import RayConfig
+
+        size = int(RayConfig.collective_socket_buffer_bytes)
+    except Exception:  # graftlint: disable=silent-except -- config not importable in stripped test harnesses; kernel defaults are functional
+        size = 0
+    if size > 0:
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, size)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, size)
+        except OSError:
+            pass  # kernel clamp (rmem_max/wmem_max); the clamped value still helps
+
+
+def _send_view_chunked(sock: socket.socket, view: memoryview, chunk: int = 0) -> None:
+    """Pipelined zero-copy send of a raw byte view: bounded memoryview
+    slices straight from the source buffer — no tobytes()/full-array
+    materialization ever, and per-syscall chunks small enough that the
+    receiver's recv_into drains concurrently (the pipelining half of the
+    p2p throughput fix; _configure_socket is the buffer half)."""
+    if chunk <= 0:
+        try:
+            from ray_tpu._private.config import RayConfig
+
+            chunk = int(RayConfig.device_transfer_chunk_bytes)
+        except Exception:  # graftlint: disable=silent-except -- config optional here; fall back to the module default
+            chunk = _IO_CHUNK
+        chunk = max(chunk, 1 << 16)
+    n = view.nbytes
+    off = 0
+    while off < n:
+        sock.sendall(view[off : off + chunk])
+        off += chunk
+
+
+def send_array_frame(sock: socket.socket, dtype_str: str, shape, data: memoryview) -> None:
+    """One typed-array frame from a RAW byte view (device-tier transfer
+    plane): identical wire format to _send_array, but the payload never
+    passes through an ndarray or a tobytes() — the bytes stream straight
+    from the caller's pinned buffer in pipelined chunks."""
+    dt = dtype_str.encode("ascii")
+    header = (
+        _U16.pack(len(dt))
+        + dt
+        + _U8.pack(len(shape))
+        + struct.pack(f"<{len(shape)}q", *shape)
+    )
+    sock.sendall(_LEN.pack(len(header) + data.nbytes) + header)
+    _send_view_chunked(sock, data)
+
+
+def recv_array_frame(sock: socket.socket) -> np.ndarray:
+    """Receive one typed-array frame (recv_into a preallocated buffer;
+    the returned array wraps that buffer — one copy total end to end)."""
+    return _recv_array(sock)
+
+
 def _self_ip() -> str:
     """The IP other hosts reach us at (UDP-connect trick; no traffic sent)."""
     try:
@@ -130,7 +194,7 @@ def _decode_array(payload) -> np.ndarray:
 def _send_array(sock: socket.socket, arr: np.ndarray):
     prefix, data = _encode_array(arr)
     sock.sendall(prefix)
-    sock.sendall(data)
+    _send_view_chunked(sock, data)
 
 
 def _recv_payload(sock: socket.socket) -> bytearray:
@@ -317,7 +381,7 @@ class DcnGroup:
                     continue
                 except OSError:
                     return
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _configure_socket(sock)
                 try:
                     # Bounded hello read: length is attacker-controlled until
                     # verified, so never allocate it blindly, and give slow
@@ -351,7 +415,7 @@ class DcnGroup:
                 if time.time() > deadline:
                     raise
                 time.sleep(0.05)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _configure_socket(s)
         _send_msg(s, f"{self.group_name}\n{self.rank}\n{token}".encode())
         self._next_sock = s
         t.join(timeout=120)
@@ -386,7 +450,7 @@ class DcnGroup:
             except OSError:
                 return
             try:
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _configure_socket(sock)
                 sock.settimeout(5)
                 parts = _recv_bounded_msg(sock, max_len=4096).decode().split("\n")
                 if (
@@ -430,7 +494,7 @@ class DcnGroup:
             s = None
             try:
                 s = socket.create_connection((host, int(port)), timeout=10)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _configure_socket(s)
                 _send_msg(s, hello)
                 s.settimeout(10)
                 if _recv_bounded_msg(s, max_len=16) == b"ok":
@@ -484,11 +548,18 @@ class DcnGroup:
         out = self.allreduce(arr, op)
         return out if self.rank == dst_rank else arr
 
-    def broadcast(self, arr: np.ndarray, src_rank: int = 0) -> np.ndarray:
-        """Ring rotation: src sends, each rank forwards n-1 hops."""
+    def broadcast(self, arr: np.ndarray, src_rank: int = 0, topology: str = "ring") -> np.ndarray:
+        """Broadcast from src_rank.  ``topology="ring"`` rotates around the
+        ring (n-1 serial hops — bandwidth-fine, latency O(n)); ``"tree"``
+        runs a binomial tree over the p2p links (O(log n) depth, and every
+        internal rank re-serves its subtree so aggregate bandwidth stops
+        being bottlenecked on the source's single uplink — the fan-out
+        shape the device tier's one-producer-many-consumer pulls use)."""
         n = self.world_size
         if n == 1:
             return arr
+        if topology == "tree":
+            return self._broadcast_tree(arr, src_rank)
         with self._lock:
             if self.rank == src_rank:
                 self.send_next(arr)
@@ -497,6 +568,26 @@ class DcnGroup:
             if (self.rank + 1) % n != src_rank:
                 self.send_next(data)
             return data
+
+    def _broadcast_tree(self, arr: np.ndarray, src_rank: int) -> np.ndarray:
+        """Binomial-tree broadcast (MPICH shape): rank r relative to the
+        source receives once from r minus its lowest set bit, then forwards
+        to r + mask for every mask below the receive bit."""
+        n = self.world_size
+        rel = (self.rank - src_rank) % n
+        data = arr
+        mask = 1
+        while mask < n:
+            if rel & mask:
+                data = self.recv((self.rank - mask) % n)
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask > 0:
+            if rel + mask < n:
+                self.send(np.asarray(data), (self.rank + mask) % n)
+            mask >>= 1
+        return data
 
     def allgather(self, arr: np.ndarray) -> List[np.ndarray]:
         n = self.world_size

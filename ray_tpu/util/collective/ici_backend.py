@@ -31,6 +31,26 @@ _OP_TO_JAX = {
 }
 
 
+def to_device(value, device=None):
+    """Place a host/device array on `device` (default: first local device)
+    with at most one D2D/H2D copy — the device tier's re-import hop for a
+    consumer whose mesh doesn't already hold the producer's buffers
+    (core/DEVICE_TIER.md).  An array already resident on the target
+    device is returned as-is (zero-copy identity)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    devs = getattr(value, "devices", None)
+    if callable(devs):
+        try:
+            if devs() == {device}:
+                return value  # already exactly there
+        except Exception:  # graftlint: disable=silent-except -- sharding introspection is best-effort; device_put below is always correct
+            pass
+    return jax.device_put(value, device)
+
+
 def _psum_like(x, op_name: str, axis_name: str):
     import jax
 

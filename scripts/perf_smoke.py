@@ -61,6 +61,30 @@ def main() -> int:
         split[key] = split.get(key, 0) + 1
     fast = split.get("cached_lease", 0) + split.get("raylet", 0)
     total = sum(split.values())
+
+    # -- device-tier gate (core/DEVICE_TIER.md): a put that rides the
+    # device tier must (a) actually register there (not silently fall back
+    # to shm), (b) resolve bit-identically cross-process, and (c) clear a
+    # modest MB/s floor — the collective pull plane measured ~800 MB/s on
+    # a 1-core box, so 100 MB/s only trips when pulls re-serialize
+    # through the host path.
+    import numpy as np
+
+    dev_floor = float(os.environ.get("PERF_SMOKE_FLOOR_DEVICE_MB_S", "100"))
+    arr = np.arange(4 * 1024 * 1024, dtype=np.float64)  # 32MB
+
+    @ray_tpu.remote
+    def checksum(x):
+        return float(np.asarray(x).sum())
+
+    dref = ray_tpu.put(arr, tier="device")
+    mem = cw.request(MsgType.TASK_SUMMARY, {"what": "memory"})
+    dev = mem.get("device_tier", {})
+    t0 = time.perf_counter()
+    got = ray_tpu.get(checksum.remote(dref), timeout=120)
+    dev_rate = (arr.nbytes / (1024 * 1024)) / (time.perf_counter() - t0)
+    dev_ok = got == float(arr.sum())
+
     print(
         json.dumps(
             {
@@ -68,11 +92,31 @@ def main() -> int:
                 "floor": floor,
                 "granted_by": split,
                 "fast_path_fraction": round(fast / max(1, total), 3),
+                "device_tier_objects": dev.get("objects", 0),
+                "device_transfer_mb_per_sec": round(dev_rate, 1),
+                "device_floor_mb_per_sec": dev_floor,
             }
         )
     )
     ray_tpu.shutdown()
 
+    if dev.get("objects", 0) < 1:
+        print(
+            "FAIL: device-tier put did not register in the device tier "
+            f"(summary: {dev})",
+            file=sys.stderr,
+        )
+        return 1
+    if not dev_ok:
+        print("FAIL: device-tier cross-process get not bit-identical", file=sys.stderr)
+        return 1
+    if dev_rate < dev_floor:
+        print(
+            f"FAIL: device-tier transfer {dev_rate:.0f} MB/s below floor "
+            f"{dev_floor:.0f} MB/s (pulls falling back to the host path?)",
+            file=sys.stderr,
+        )
+        return 1
     if rate < floor:
         print(
             f"FAIL: queued-drain {rate:.0f}/s below floor {floor:.0f}/s "

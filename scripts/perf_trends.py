@@ -116,6 +116,18 @@ def _parse_perf(path: str, run: str, table, notes: List[str]):
     for k, v in (micro or {}).items():
         if isinstance(v, (int, float)):
             _series(f"perf.micro.{k}", v, run, table)  # informational only
+    # device-tier transfer pair (PR 17, core/DEVICE_TIER.md): the MB/s
+    # rows are box-sensitive so they stay informational above, but the
+    # device-vs-host RATIOS are same-box same-run quotients — variance
+    # cancels, so a ratio collapse means the device plane itself broke
+    # (e.g. pulls silently falling back to host TCP).  Gate those.
+    for key, series in (
+        ("obs transfer device vs host speedup", "perf.obs_transfer_device_speedup"),
+        ("broadcast tree vs host speedup", "perf.broadcast_tree_speedup"),
+    ):
+        v = (micro or {}).get(key)
+        if isinstance(v, (int, float)):
+            _series(series, v, run, table, tracked=True)
     se = d.get("scale_envelope") or {}
     qt = se.get("queued_tasks_10k") or {}
     if "throughput_per_sec" in qt:

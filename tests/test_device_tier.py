@@ -1,0 +1,213 @@
+"""Device-resident object tier (core/DEVICE_TIER.md): HBM/device-pinned
+puts, zero-copy same-process gets, collective cross-process transfer,
+device→shm→disk eviction ladder, holder-loss fallback, stamp-free
+events-off path.
+
+The acceptance contract these tests pin down:
+
+- a device-tier put moves NO bytes through the shm store (the object is
+  recorded in the directory and pinned in place),
+- same-process get returns the LITERAL pinned array (identity, not a
+  copy),
+- cross-process gets are bit-identical to the host path,
+- LRU eviction demotes device→shm (META_DEVICE envelope) and from there
+  rides the ordinary shm→disk spill chain, restoring transparently,
+- killing the producer mid-pull surfaces a typed ObjectLostError (or a
+  successful fallback through another plane — never a hang or garbage),
+- with task events off, the device paths stamp nothing.
+"""
+
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.protocol import MsgType
+
+pytestmark = pytest.mark.device_tier
+
+MB = 1024 * 1024
+
+
+def _core_worker():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker.core_worker
+
+
+def test_same_process_zero_copy_identity(shutdown_only):
+    """A large jax array routes to the device tier automatically; the
+    same-process get returns the LITERAL pinned array and no bytes ever
+    enter the shm store."""
+    import jax.numpy as jnp
+
+    ray_tpu.init(num_cpus=2)
+    arr = jnp.arange(1 << 20, dtype=jnp.float32)  # 4MB >= device_tier_min_bytes
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref, timeout=60)
+    assert got is arr, "same-process device-tier get must be the pinned array itself"
+
+    cw = _core_worker()
+    assert cw.store.contains(ref._id) is False, (
+        "device-tier put leaked bytes into the shm store"
+    )
+    # directory accounting: the object is visible in `summary memory`
+    # under the device tier, with its real nbytes
+    mem = cw.request(MsgType.TASK_SUMMARY, {"what": "memory"})
+    dev = mem.get("device_tier", {})
+    assert dev.get("objects", 0) >= 1
+    assert dev.get("bytes", 0) >= arr.nbytes
+
+
+def test_np_explicit_tier_identity(shutdown_only):
+    """tier="device" pins ANY array (np included) regardless of size;
+    identity holds on the same-process get."""
+    ray_tpu.init(num_cpus=2)
+    arr = np.arange(1024, dtype=np.int64)  # tiny: only explicit tier pins it
+    ref = ray_tpu.put(arr, tier="device")
+    got = ray_tpu.get(ref, timeout=60)
+    assert got is arr
+    assert _core_worker().store.contains(ref._id) is False
+
+
+def test_cross_process_bit_identical(shutdown_only):
+    """A worker pulling a device-tier object over the collective plane
+    sees exactly the bytes the host path would have delivered."""
+    ray_tpu.init(num_cpus=2)
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 256, 4 * MB, dtype=np.uint8)
+
+    @ray_tpu.remote
+    def digest(x):
+        return hashlib.sha256(np.ascontiguousarray(x).tobytes()).hexdigest()
+
+    want = hashlib.sha256(arr.tobytes()).hexdigest()
+    host_ref = ray_tpu.put(arr, tier="host")
+    dev_ref = ray_tpu.put(arr, tier="device")
+    assert ray_tpu.get(digest.remote(host_ref), timeout=120) == want
+    assert ray_tpu.get(digest.remote(dev_ref), timeout=120) == want
+
+
+def test_eviction_ladder_device_shm_disk_restore(shutdown_only):
+    """LRU pressure demotes device→shm (META_DEVICE envelope), shm
+    pressure spills the envelope to disk, and the object restores
+    bit-identically from every rung — counted ONCE per tier, never
+    double-counted after the demotion."""
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=32 * MB,
+        _system_config={"device_store_capacity": 9 * MB},
+    )
+    cw = _core_worker()
+
+    first = np.arange(1 * MB, dtype=np.float32)  # 4MB
+    ref0 = ray_tpu.put(first, tier="device")
+    # two more 4MB pins overflow the 9MB device budget → ref0 demotes
+    pins = [
+        ray_tpu.put(np.full(1 * MB, float(i), np.float32), tier="device")
+        for i in range(1, 3)
+    ]
+    assert cw.store.contains(ref0._id), (
+        "evicted device object must land in shm as its META_DEVICE envelope"
+    )
+    # no double-count: the directory now carries ref0 under shm, and the
+    # device tier's byte gauge only covers the still-pinned objects
+    mem = cw.request(MsgType.TASK_SUMMARY, {"what": "memory"})
+    assert mem.get("device_tier", {}).get("bytes", 0) <= 9 * MB
+
+    got = ray_tpu.get(ref0, timeout=60)
+    np.testing.assert_array_equal(np.asarray(got), first)
+
+    # shm pressure pushes the envelope down the ordinary disk-spill chain
+    ballast = [ray_tpu.put(np.full(1 * MB, float(i))) for i in range(12)]
+    got = ray_tpu.get(ref0, timeout=120)
+    np.testing.assert_array_equal(np.asarray(got), first)
+    del ballast, pins
+
+
+def test_chaos_kill_producer_mid_pull(shutdown_only):
+    """Killing the producer node that pins a device-tier object either
+    surfaces the typed ObjectLostError or succeeds through a fallback
+    plane — never a hang, never corrupt bytes."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import ObjectLostError
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        node = c.add_node(num_cpus=2, resources={"away": 1.0})
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(resources={"away": 1.0})
+        class Producer:
+            def pin(self):
+                self.arr = np.arange(2 * MB, dtype=np.float32)
+                return ray_tpu.put(self.arr, tier="device")
+
+        prod = Producer.remote()
+        ref = ray_tpu.get(prod.pin.remote(), timeout=120)
+
+        c.remove_node(node, allow_graceful=False)
+        time.sleep(1.0)  # let the head observe the disconnect
+
+        try:
+            got = ray_tpu.get(ref, timeout=60)
+        except ObjectLostError:
+            pass  # the typed loss is an acceptable outcome
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(got), np.arange(2 * MB, dtype=np.float32)
+            )
+    finally:
+        c.shutdown()
+
+
+def test_events_off_stamp_free(shutdown_only, monkeypatch):
+    """With task events disabled, device-tier puts/pulls leave NO
+    device_tier stamps in the cluster event ring (the events-off hot
+    path is stamp-free by contract)."""
+    from ray_tpu._private import task_events
+
+    monkeypatch.setenv("RAY_TPU_TASK_EVENTS", "0")  # inherited by workers
+    task_events.set_enabled(False)
+    try:
+        ray_tpu.init(num_cpus=2)
+        arr = np.arange(1 * MB, dtype=np.float32)
+        ref = ray_tpu.put(arr, tier="device")
+
+        @ray_tpu.remote
+        def total(x):
+            return float(np.asarray(x).sum())
+
+        assert ray_tpu.get(total.remote(ref), timeout=120) == float(arr.sum())
+        events = _core_worker().request(MsgType.LIST_EVENTS, {})["events"]
+        stamps = [e for e in events if e.get("source") == "device_tier"]
+        assert stamps == [], f"events-off run stamped device_tier events: {stamps}"
+    finally:
+        task_events.set_enabled(True)
+
+
+def test_events_on_stamps_put_and_pull(shutdown_only):
+    """The flight recorder carries device_put on the producer and
+    device_pull on the consumer when events are on."""
+    ray_tpu.init(num_cpus=2)
+    arr = np.arange(1 * MB, dtype=np.float32)
+    ref = ray_tpu.put(arr, tier="device")
+
+    @ray_tpu.remote
+    def total(x):
+        return float(np.asarray(x).sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=120) == float(arr.sum())
+    deadline = time.time() + 10
+    msgs: set = set()
+    while time.time() < deadline:
+        events = _core_worker().request(MsgType.LIST_EVENTS, {})["events"]
+        msgs = {
+            e.get("message") for e in events if e.get("source") == "device_tier"
+        }
+        if {"device_put", "device_pull"} <= msgs:
+            break
+        time.sleep(0.3)
+    assert "device_put" in msgs and "device_pull" in msgs, msgs
