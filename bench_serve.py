@@ -51,6 +51,17 @@ MIXED_NEW = {"short": 8, "long": 96}  # per-class token budgets
 MIXED_LONG_FRAC = 0.25
 
 
+# ---- fleet survival section (serve/FLEET.md): seeded Poisson stream
+# spike against an SLO-autoscaled engine fleet — scale-out reaction
+# time, mid-stream failover count under a replica kill, and client-side
+# TTFT p99 with/without the kill.  Tiny model: the section measures the
+# CONTROL plane (scaling, drain, failover), not FLOPs.
+FLEET = os.environ.get("SERVE_BENCH_FLEET", "1") not in ("0", "false")
+FLEET_N = int(os.environ.get("SERVE_BENCH_FLEET_N", "24"))
+FLEET_RPS = float(os.environ.get("SERVE_BENCH_FLEET_RPS", "16"))
+FLEET_NEW = int(os.environ.get("SERVE_BENCH_FLEET_NEW", "48"))
+
+
 def _poisson_schedule(rng, n, rate):
     """Deterministic (seeded) arrival schedule replayed identically
     against both systems: [(t_offset, class, prompt_tokens)]."""
@@ -180,6 +191,197 @@ def mixed_workload_bench(ray_tpu, serve):
     }
 
 
+def _fleet_busy_replica(ray_tpu, name):
+    """Index of the replica actively decoding (slots_active > 0) — the
+    load snapshots lag, so ask the engines directly."""
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_handles.remote(name), timeout=30)
+    for i, r in enumerate(info["replicas"]):
+        try:
+            st = ray_tpu.get(
+                r.handle_request.remote("engine_stats", (), {}), timeout=30
+            )
+        except Exception:  # noqa: BLE001 — a booting/dead replica just isn't busy
+            continue
+        if st.get("slots_active", 0.0) > 0:
+            return i
+    return -1
+
+
+def _fleet_stream_trace(ray_tpu, handle, sched, name, kill_at=None):
+    """Replay a seeded Poisson arrival schedule as token STREAMS (one
+    thread per request, arrivals open-loop), recording client-side TTFT
+    per stream.  ``kill_at``: after that many launches, SIGKILL the busy
+    replica — every stream must still complete its full budget through
+    mid-stream failover."""
+    import threading
+
+    from ray_tpu.util import chaos_api
+
+    results: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def _one(prompt):
+        t0 = time.time()
+        ttft, n = None, 0
+        try:
+            for fr in handle.stream_tokens(
+                {"prompt": prompt, "max_new_tokens": FLEET_NEW}
+            ):
+                if ttft is None:
+                    ttft = time.time() - t0
+                n += len(fr)
+            with lock:
+                results.append((ttft, n))
+        except Exception as e:  # noqa: BLE001 — a dropped stream IS the result
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+    threads = []
+    t0 = time.time()
+    for i, (t_off, _cls, prompt) in enumerate(sched):
+        while time.time() - t0 < t_off:
+            time.sleep(min(0.002, max(0.0, t_off - (time.time() - t0))))
+        th = threading.Thread(target=_one, args=(prompt,), daemon=True)
+        th.start()
+        threads.append(th)
+        if kill_at is not None and i == kill_at:
+            idx = _fleet_busy_replica(ray_tpu, name)
+            if idx >= 0:
+                chaos_api.kill_replica(name, idx)
+    for th in threads:
+        th.join(600)
+    ttfts = np.asarray([t for t, _ in results if t is not None]) * 1000
+    return {
+        "completed": len(results),
+        "full_budget": sum(1 for _, n in results if n == FLEET_NEW),
+        "errors": errors,
+        "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 1)
+        if len(ttfts)
+        else None,
+    }
+
+
+def fleet_survival_bench(ray_tpu, serve):
+    """Fleet survival headline numbers (serve/FLEET.md): one seeded
+    Poisson stream spike drives an SLO-autoscaled 2-replica engine
+    fleet.  Phase 1 (spike, no kill): the spike breaches an aggressive
+    latency SLO and the watchdog scales 1→2 — reaction time is spike
+    start to the controller's target moving.  Phase 2 (kill): the same
+    trace replays against the 2-replica fleet with the busy replica
+    SIGKILLed mid-stream — failovers resume every stream from its
+    delivered frontier, and the TTFT p99 delta vs phase 1 prices the
+    survival machinery."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.api import CONTROLLER_NAME
+    from ray_tpu.serve.llm import engine_llm_deployment
+    from ray_tpu.util import slo_api
+
+    cfg = LlamaConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        vocab_size=256, compute_dtype=jnp.float32, max_seq_len=128,
+    )
+    dep = engine_llm_deployment(
+        cfg, new_tokens=FLEET_NEW, num_slots=4, page_size=16,
+        prefill_chunk=16, num_tpus=0, tp=1, name="llm_fleet",
+    )
+    handle = serve.run(dep.bind())  # 1 replica; the SLO scales it out
+    # warm the compile before the clock starts
+    _ = [t for fr in handle.stream_tokens(
+        {"prompt": [1, 2, 3], "max_new_tokens": 4}) for t in fr]
+    rng = np.random.default_rng(7)
+    sched = [
+        (t, c, [int(x) for x in rng.integers(1, 255, 8)])
+        for (t, c, _p) in _poisson_schedule(rng, FLEET_N, FLEET_RPS)
+    ]
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _target():
+        deps = ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+        return deps.get("llm_fleet", {}).get("target", 0)
+
+    # any completed request breaches a 1µs p50 bound → sustained burn →
+    # the watchdog publishes ONE scale_out directive per cooldown window
+    slo_api.set_slos([{
+        "name": "fleet_bench_latency",
+        "metric": "ray_tpu_serve_request_seconds",
+        "tags": {"deployment": "llm_fleet"},
+        "quantile": 0.5,
+        "threshold_ms": 0.001,
+        "window_s": 60,
+        "scale_on_slo": {"deployment": "llm_fleet",
+                         "min_replicas": 1, "max_replicas": 2},
+    }])
+    reaction = [None]
+    spike_t0 = time.time()
+
+    def _watch_scale():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if _target() >= 2:
+                reaction[0] = round(time.time() - spike_t0, 2)
+                return
+            time.sleep(0.25)
+
+    watcher = threading.Thread(target=_watch_scale, daemon=True)
+    watcher.start()
+    no_kill = _fleet_stream_trace(ray_tpu, handle, sched, "llm_fleet")
+    # the watchdog evaluates windowed DELTAS per observer tick: a spike
+    # that completes inside one tick leaves later deltas empty, so keep
+    # a trickle flowing until the sustained burn publishes the directive
+    trickle_deadline = time.time() + 90
+    while reaction[0] is None and time.time() < trickle_deadline:
+        try:
+            ray_tpu.get(
+                handle.remote({"prompt": [5, 6, 7], "max_new_tokens": 2}),
+                timeout=60,
+            )
+        except Exception:  # noqa: BLE001 — trickle is best-effort load
+            pass
+        time.sleep(0.4)
+    watcher.join(10)
+    slo_api.clear_slos()
+    # wait for the scaled-out fleet to be live before the kill phase
+    deadline = time.time() + 60
+    while time.time() < deadline and _target() < 2:
+        time.sleep(0.5)
+    with_kill = _fleet_stream_trace(
+        ray_tpu, handle, sched, "llm_fleet", kill_at=FLEET_N // 3
+    )
+    failovers = 0
+    try:
+        from ray_tpu.experimental.state import summarize_workloads
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            fleet = (summarize_workloads("serve") or {}).get("fleet") or {}
+            failovers = int(fleet.get("llm_fleet", {}).get("failovers_total", 0))
+            if failovers:
+                break
+            time.sleep(0.5)
+    except Exception as e:  # noqa: BLE001 — bench must still emit a row
+        print(f"fleet summary unavailable: {e}")
+    serve.delete("llm_fleet")
+    return {
+        "requests_per_phase": FLEET_N,
+        "arrival_rate_rps": FLEET_RPS,
+        "new_tokens": FLEET_NEW,
+        "scale_out_reaction_s": reaction[0],
+        "failovers": failovers,
+        "ttft_ms_p99_no_kill": no_kill["ttft_ms_p99"],
+        "ttft_ms_p99_with_kill": with_kill["ttft_ms_p99"],
+        "no_kill": no_kill,
+        "with_kill": with_kill,
+    }
+
+
 def main():
     import jax
 
@@ -291,6 +493,16 @@ def main():
 
             traceback.print_exc()
             result["mixed_workload"] = {"error": f"{type(e).__name__}: {e}"}
+    if FLEET:
+        # fleet survival: SLO-driven scale-out reaction, failover count
+        # and TTFT p99 under a mid-stream replica kill (serve/FLEET.md)
+        try:
+            result["fleet"] = fleet_survival_bench(ray_tpu, serve)
+        except Exception as e:  # noqa: BLE001 — prior sections' rows must still land
+            import traceback
+
+            traceback.print_exc()
+            result["fleet"] = {"error": f"{type(e).__name__}: {e}"}
     with open("SERVE_BENCH_r05.json", "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

@@ -78,6 +78,8 @@ _CONFIG_DEF: Dict[str, tuple] = {
     "priority_fair_quantum_s": (float, 0.1, "deficit drained from a job's fair-share counter per dispatch (within-band weighted round-robin over queue-wait)"),
     "slo_preempt_sustain_ticks": (int, 2, "consecutive breaching observer ticks before an SLO with preempt_below_band triggers a policy preemption"),
     "slo_preempt_cooldown_s": (float, 5.0, "minimum spacing between SLO-policy preemptions"),
+    "slo_scale_sustain_ticks": (int, 2, "consecutive breaching observer ticks before an SLO with scale_on_slo emits a serve scale-out directive"),
+    "slo_scale_cooldown_s": (float, 10.0, "minimum spacing between SLO-policy scale directives per deployment (out or in); must outlast replica spawn+compile or the fleet oscillates"),
     # -- sampling profiler (_private/profiler.py; RAY_TPU_PROFILER env
     #    gates the plane itself — see the module docstring) --
     "profiler_hz": (int, 67, "wall-clock sampling rate while armed (67 is co-prime with common 10/50/100 Hz periodic work, so the sampler can't alias against it)"),
@@ -104,6 +106,8 @@ _CONFIG_DEF: Dict[str, tuple] = {
     # -- serve --
     "serve_long_poll_timeout_s": (float, 30.0, "long-poll listen timeout"),
     "serve_queue_length_response_deadline_s": (float, 0.1, "router queue probe deadline"),
+    "serve_drain_deadline_s": (float, 30.0, "graceful-drain budget on scale-in: a draining replica finishes in-flight work within this window or is killed (deadline escalation, recorded as outcome=deadline)"),
+    "serve_load_poll_period_s": (float, 1.0, "controller poll period for replica load snapshots (queue depth, KV-page pressure) piggybacked onto routing publishes for least-pressure routing"),
     # -- compiled actor DAGs (ray_tpu/dag/) --
     "dag_ring_slot_min_bytes": (int, 1 << 20, "minimum slot size for a compiled-DAG shm channel ring (sized at 2x the first payload, floored here; bigger payloads overflow inline onto the carrier conn)"),
     "dag_channel_slots": (int, 4, "slots per compiled-DAG shm channel ring (SPSC depth before the writer back-pressures)"),

@@ -91,6 +91,40 @@ def parse_specs(blob) -> List[dict]:
                 raise ValueError(
                     f"SLO {spec['name']!r}: preempt_below_band must be >= 0"
                 )
+        if "scale_on_slo" in spec:
+            # policy output: a sustained burn on this SLO scales a serve
+            # deployment out (one replica per directive, bounded by
+            # max_replicas); recovery scales back in through the graceful
+            # drain protocol (gcs/server.py _apply_slo_scale →
+            # serve/controller.py apply_fleet_directive).  Accepts a bare
+            # deployment name or a dict; normalized to the dict form.
+            sc = spec["scale_on_slo"]
+            if isinstance(sc, str):
+                sc = {"deployment": sc}
+            if not isinstance(sc, dict) or not sc.get("deployment"):
+                raise ValueError(
+                    f"SLO {spec['name']!r}: scale_on_slo must be a deployment "
+                    "name or a dict with a 'deployment' key"
+                )
+            norm = {"deployment": str(sc["deployment"])}
+            for bound, default in (("min_replicas", 1), ("max_replicas", 8)):
+                try:
+                    norm[bound] = int(sc.get(bound, default))
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"SLO {spec['name']!r}: scale_on_slo.{bound} must be an int"
+                    )
+                if norm[bound] < 1:
+                    raise ValueError(
+                        f"SLO {spec['name']!r}: scale_on_slo.{bound} must be >= 1"
+                    )
+            if norm["max_replicas"] < norm["min_replicas"]:
+                raise ValueError(
+                    f"SLO {spec['name']!r}: scale_on_slo.max_replicas must be "
+                    ">= min_replicas"
+                )
+            spec = dict(spec)
+            spec["scale_on_slo"] = norm
         out.append(spec)
     return out
 

@@ -210,6 +210,37 @@ class EngineStreamError(RayError):
     never a silent hang."""
 
 
+class DeploymentBackpressureError(RayError):
+    """Every replica of a deployment is at its admission bound — the
+    handle's inflight cap plus the fleet's reported load leave nowhere to
+    route.  Raised instead of silently over-admitting onto a saturated
+    replica; the HTTP proxy maps it to 503 with ``Retry-After``.  Shedding
+    at this layer fires only when the WHOLE fleet is saturated — a single
+    replica's overload is retried on the next-least-loaded sibling first
+    (serve/handle.py)."""
+
+    def __init__(
+        self,
+        message: str = "all replicas saturated",
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+    def __reduce__(self):
+        # keep retry_after_s across process boundaries (default reduce
+        # would replay __init__ with args=(message,) only)
+        return (DeploymentBackpressureError, (self.args[0], self.retry_after_s))
+
+
+class ReplicaDrainingError(RayError):
+    """The replica is mid-drain (scale-in in progress): it runs its
+    in-flight and mailbox-queued work to retirement but refuses NEW
+    engine token streams — the one admission whose caller is guaranteed
+    to retry (stream_tokens excludes the replica and picks a sibling),
+    so a drain is invisible to clients rather than a burst of errors."""
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

@@ -459,6 +459,14 @@ class HeadServer:
         self._slo_breach_ticks: Dict[str, int] = {}
         self._last_policy_preempt = 0.0
         self._preempt_scans_left = 0  # per-tick victim-scan budget
+        # SLO scale policy (serve/FLEET.md): per-spec breach/recovery tick
+        # counters, outstanding scale-out debt (bounds scale-in so
+        # recovery never drains below what the policy added), and a
+        # per-deployment cooldown stamp
+        self._slo_scale_ticks: Dict[str, int] = {}
+        self._slo_recover_ticks: Dict[str, int] = {}
+        self._slo_scale_debt: Dict[str, int] = {}
+        self._last_policy_scale: Dict[str, float] = {}
         # cluster-wide sampling profiler (_private/profiler.py): folded
         # stacks aggregated per (role, node) from batched PROFILE_STATS
         # frames, flush-window slices for the chrome timeline, one-shot
@@ -4082,10 +4090,36 @@ class HeadServer:
             "ttft": {d: _percentiles(v) for d, v in ttft.items()},
             "tpot": {d: _percentiles(v) for d, v in tpot.items()},
             "engine": self._engine_gauges(),
+            "fleet": self._fleet_gauges(),
             "total_records": len(records),
         }
         if limit > 0:
             out["records"] = records[-limit:]
+        return out
+
+    def _fleet_gauges(self) -> dict:
+        """Fleet-survival view per deployment, read from the
+        ``ray_tpu_serve_fleet_*`` families (controller publishes
+        replicas/scale/drain, handles publish failovers; counter series
+        sum across processes) — `ray-tpu summary serve`'s fleet block."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        raw = metrics_mod.raw_records_from_kv(self.kv)
+        fleet_raw = {
+            k: v for k, v in raw.items() if k.startswith("ray_tpu_serve_fleet_")
+        }
+        if not fleet_raw:
+            return {}
+        out: dict = {}
+        for key, rec in sorted(metrics_mod.merge_series(fleet_raw).items()):
+            name, _, _ = metrics_mod.parse_series_key(key)
+            tags = dict(rec.get("tags") or {})
+            dep = tags.pop("deployment", "?")
+            slot = out.setdefault(dep, {})
+            short = name[len("ray_tpu_serve_fleet_"):]
+            if tags:
+                short += ":" + ",".join(f"{v}" for _, v in sorted(tags.items()))
+            slot[short] = rec.get("value", 0.0)
         return out
 
     def _engine_gauges(self) -> dict:
@@ -5753,6 +5787,80 @@ class HeadServer:
         ):
             self._last_policy_preempt = now
 
+    def _apply_slo_scale(self, spec: dict, verdict: dict, now: float):
+        """Second SLO policy output (serve/FLEET.md): a sustained burn on
+        a spec carrying ``scale_on_slo`` publishes a scale_out directive
+        on the ``serve:fleet`` channel; sustained recovery unwinds the
+        outstanding scale-outs one scale_in at a time (each retires a
+        replica through the controller's graceful drain).  Directives,
+        not RPCs: the head never blocks on the controller, and a
+        controller mid-restart just misses one tick.  The controller
+        clamps to [min_replicas, max_replicas] independently — the debt
+        counter here only bounds directive EMISSION so recovery cannot
+        drain below what the policy added."""
+        sc = spec.get("scale_on_slo")
+        if not isinstance(sc, dict) or not sc.get("deployment"):
+            return
+        name = spec["name"]
+        dep = str(sc["deployment"])
+        if verdict["ok"]:
+            self._slo_scale_ticks.pop(name, None)
+            if self._slo_scale_debt.get(name, 0) <= 0:
+                self._slo_recover_ticks.pop(name, None)
+                return
+            rticks = self._slo_recover_ticks.get(name, 0) + 1
+            self._slo_recover_ticks[name] = rticks
+            if rticks < RayConfig.slo_scale_sustain_ticks:
+                return
+            if now - self._last_policy_scale.get(dep, 0.0) < RayConfig.slo_scale_cooldown_s:
+                return
+            self._slo_scale_debt[name] -= 1
+            self._last_policy_scale[dep] = now
+            self._emit_fleet_directive(
+                "scale_in", dep, sc, slo=name, reason="slo recovered"
+            )
+            return
+        self._slo_recover_ticks.pop(name, None)
+        ticks = self._slo_scale_ticks.get(name, 0) + 1
+        self._slo_scale_ticks[name] = ticks
+        if ticks < RayConfig.slo_scale_sustain_ticks:
+            return
+        if now - self._last_policy_scale.get(dep, 0.0) < RayConfig.slo_scale_cooldown_s:
+            return
+        ceiling = max(
+            0, int(sc.get("max_replicas", 8)) - int(sc.get("min_replicas", 1))
+        )
+        if self._slo_scale_debt.get(name, 0) >= ceiling:
+            return  # policy already holds the spec's whole headroom
+        self._slo_scale_debt[name] = self._slo_scale_debt.get(name, 0) + 1
+        self._last_policy_scale[dep] = now
+        self._emit_fleet_directive(
+            "scale_out", dep, sc, slo=name, reason="sustained burn"
+        )
+
+    def _emit_fleet_directive(self, op: str, deployment: str, sc: dict, slo: str, reason: str):
+        """Fire one serve:fleet directive + its timeline event.  Runs
+        inside the observer loop on the head's event loop, so the publish
+        is scheduled, never awaited — policy must not stall on a slow
+        subscriber."""
+        msg = {
+            "op": op,
+            "deployment": deployment,
+            "min_replicas": int(sc.get("min_replicas", 1)),
+            "max_replicas": int(sc.get("max_replicas", 8)),
+            "slo": slo,
+            "reason": reason,
+        }
+        asyncio.ensure_future(self._publish("serve:fleet", msg))
+        self._record_event(
+            "WARNING" if op == "scale_out" else "INFO",
+            "serve_fleet",
+            f"fleet directive {op}: {deployment} ({reason}, slo {slo})",
+            deployment=deployment,
+            op=op,
+            slo=slo,
+        )
+
     def _policy_preempt(self, band_below: int, reason: str) -> bool:
         """Evict ONE victim below `band_below`, lowest band first,
         bottom-up across the cluster (cached leases, idle preemptible
@@ -5994,6 +6102,15 @@ class HeadServer:
             }
             if not self._slo_breach_ticks:
                 self._slo_preempt_hold = False
+            # ...nor keep driving scale directives for a retired spec
+            for st in (
+                self._slo_scale_ticks,
+                self._slo_recover_ticks,
+                self._slo_scale_debt,
+            ):
+                for n in list(st):
+                    if n not in live:
+                        st.pop(n, None)
         if not self._slo_specs:
             return
         merged = self._slo_metrics_view()
@@ -6044,6 +6161,9 @@ class HeadServer:
             # policy output: sustained burn → preempt the lowest band;
             # recovery → lift the re-admission hold
             self._apply_slo_policy(spec, verdict, now)
+            # second policy output: sustained burn → serve scale-out
+            # directive; sustained recovery → scale-in (graceful drain)
+            self._apply_slo_scale(spec, verdict, now)
 
     async def _idle_reaper_loop(self):
         while not self._shutdown:

@@ -171,6 +171,23 @@ def _latency_table(rows, key_a, key_b, label_a, label_b):
         )
 
 
+def _print_fleet_gauges(fleet: dict) -> None:
+    """Serve fleet-survival block for `summary serve`: replica count,
+    scale events, mid-stream failovers, drain outcomes per deployment."""
+    if not fleet:
+        return
+    print("== serve fleet ==")
+    for dep, g in sorted(fleet.items()):
+        print(
+            f"  {dep}: replicas={g.get('replicas', 0):.0f} "
+            f"scale_out={g.get('scale_events_total:out', 0):.0f} "
+            f"scale_in={g.get('scale_events_total:in', 0):.0f} "
+            f"failovers={g.get('failovers_total', 0):.0f} "
+            f"drained(clean={g.get('drained_total:clean', 0):.0f} "
+            f"deadline={g.get('drained_total:deadline', 0):.0f})"
+        )
+
+
 def _print_engine_gauges(engine: dict) -> None:
     """Continuous-batching engine occupancy block shared by
     `summary serve` and `summary memory`."""
@@ -302,6 +319,7 @@ def cmd_summary(args):
                 f"p99={p['p99'] * 1e3:.2f}ms (n={p['count']})"
             )
         _print_engine_gauges(reply.get("engine", {}))
+        _print_fleet_gauges(reply.get("fleet", {}))
     elif args.what == "train":
         _latency_table(rows, "run", "phase", "run", "phase")
         for run, st in sorted(reply.get("runs", {}).items()):

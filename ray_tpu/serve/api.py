@@ -173,19 +173,36 @@ _STREAM_END = object()
 
 
 def _overload_retry_after(exc) -> Optional[float]:
-    """If ``exc`` is (or wraps) an EngineOverloadedError, its suggested
-    Retry-After in seconds; else None.  The engine raises it at SUBMIT
-    time in the replica, so it reaches the proxy wrapped in a
-    RayTaskError whose pickled cause survives the hop."""
-    from ray_tpu.exceptions import EngineOverloadedError
+    """If ``exc`` is (or wraps) an overload-shaped error — the engine's
+    EngineOverloadedError (replica-local admission queue full) or the
+    handle's DeploymentBackpressureError (the WHOLE fleet saturated) —
+    its suggested Retry-After in seconds; else None.  Replica-side
+    raises reach the proxy wrapped in a RayTaskError whose pickled cause
+    survives the hop."""
+    from ray_tpu.exceptions import DeploymentBackpressureError, EngineOverloadedError
 
     seen = 0
     while exc is not None and seen < 8:
-        if isinstance(exc, EngineOverloadedError):
+        if isinstance(exc, (EngineOverloadedError, DeploymentBackpressureError)):
             return max(0.0, float(getattr(exc, "retry_after_s", 1.0)))
         exc = getattr(exc, "cause", None) or exc.__cause__
         seen += 1
     return None
+
+
+def _is_replica_local_reject(exc) -> bool:
+    """True when ``exc`` wraps a SINGLE replica's rejection (overload or
+    mid-drain) rather than fleet-wide saturation — the shape the proxy
+    retries on the next-least-loaded replica before shedding 503."""
+    from ray_tpu.exceptions import EngineOverloadedError, ReplicaDrainingError
+
+    seen = 0
+    while exc is not None and seen < 8:
+        if isinstance(exc, (EngineOverloadedError, ReplicaDrainingError)):
+            return True
+        exc = getattr(exc, "cause", None) or exc.__cause__
+        seen += 1
+    return False
 
 
 class HTTPProxy:
@@ -369,21 +386,42 @@ class HTTPProxy:
                 await resp.write_eof()
                 return resp
 
-            if trace is not None:
-                ref = handle.remote(body, _serve_trace=trace)
-            else:
-                ref = handle.remote(body)
+            from ray_tpu.exceptions import DeploymentBackpressureError
+
             loop = asyncio.get_running_loop()
-            try:
-                result = await loop.run_in_executor(
-                    None, functools.partial(ray_tpu.get, ref, timeout=120)
-                )
-            except Exception as e:  # noqa: BLE001 -- overload maps to 503, the rest re-raises
-                retry = _overload_retry_after(e)
-                if retry is None:
-                    raise
-                # engine admission queue full: bounded rejection instead of
-                # unbounded queueing — clients back off per Retry-After
+            result = None
+            last_exc = None
+            # a single replica's rejection (overload / mid-drain) retries
+            # on the next-least-loaded replica before shedding — 503 only
+            # when the WHOLE fleet is saturated (serve/FLEET.md)
+            for _attempt in range(3):
+                try:
+                    if trace is not None:
+                        ref = handle.remote(body, _serve_trace=trace)
+                    else:
+                        ref = handle.remote(body)
+                except DeploymentBackpressureError as e:
+                    # nothing routable anywhere: shed now
+                    return web.Response(
+                        status=503,
+                        headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+                        text="deployment saturated",
+                    )
+                try:
+                    result = await loop.run_in_executor(
+                        None, functools.partial(ray_tpu.get, ref, timeout=120)
+                    )
+                    last_exc = None
+                    break
+                except Exception as e:  # noqa: BLE001 -- overload maps to 503, the rest re-raises
+                    if not _is_replica_local_reject(e):
+                        raise
+                    last_exc = e
+            if last_exc is not None:
+                # every attempt hit a saturated/draining replica: bounded
+                # rejection instead of unbounded queueing — clients back
+                # off per Retry-After
+                retry = _overload_retry_after(last_exc) or 1.0
                 return web.Response(
                     status=503,
                     headers={"Retry-After": str(max(1, int(retry)))},

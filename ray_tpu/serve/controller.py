@@ -17,6 +17,18 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 
+# the methods a draining replica refuses: exactly the ones whose CALLER
+# retries a sibling on the typed rejection (stream_tokens' failover loop),
+# so refusing them never drops a request.  Unary calls and generic
+# streams already in the mailbox were routed BEFORE the handle learned of
+# the drain (membership removal + the draining load flag stop new sends),
+# so they run to retirement — zero dropped requests is the drain
+# contract; the drain deadline bounds the stragglers.  Continuations
+# (engine_stream_next/cancel), stats, and load probes must keep flowing
+# or the drain protocol starves itself.
+_ADMIT_METHODS = frozenset({"engine_stream_start"})
+
+
 class Replica:
     """Replica actor body: hosts the user callable."""
 
@@ -29,6 +41,7 @@ class Replica:
             self.instance = cls_or_fn
         self.inflight = 0
         self.handled = 0
+        self.draining = False
         self._streams: Dict[int, Any] = {}
         self._next_stream = 1
         if user_config is not None:
@@ -44,6 +57,12 @@ class Replica:
         # batch assembly, prefill/decode) stamp through the contextvar
         # scope.  None (recording off / old caller) costs one check.
         trace = kwargs.pop("_serve_trace", None)
+        if self.draining and method in _ADMIT_METHODS:
+            from ray_tpu.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(
+                f"replica draining: new {method!r} work rejected"
+            )
         serve_tracing.stamp(trace, "serve_replica_recv")
         self.inflight += 1
         err = False
@@ -159,6 +178,43 @@ class Replica:
     def stats(self):
         return {"inflight": self.inflight, "handled": self.handled}
 
+    def start_drain(self):
+        """Enter the drain protocol (serve/FLEET.md): stop admitting new
+        work, let in-flight requests and streams run to retirement.
+        Idempotent; the controller's drainer polls drain_status until idle
+        or the deadline."""
+        self.draining = True
+        return True
+
+    def drain_status(self):
+        """Is this replica safe to tear down?  Generic work is covered by
+        inflight + the generator-stream table; engine deployments
+        additionally expose engine_idle() (scheduler queue empty, no
+        active slots, token-stream outboxes fully consumed)."""
+        idle = self.inflight == 0 and not self._streams
+        if idle and hasattr(self.instance, "engine_idle"):
+            try:
+                idle = bool(self.instance.engine_idle())
+            except Exception:
+                idle = False  # can't prove idle: keep draining
+        return {"draining": self.draining, "inflight": self.inflight, "idle": idle}
+
+    def load(self):
+        """Cheap load snapshot for least-pressure routing: generic
+        inflight plus engine pressure (queue depth, KV-page fraction)
+        when the instance exposes engine_load().  Piggybacked onto the
+        controller's routing publishes — handles never probe replicas."""
+        out: Dict[str, Any] = {
+            "inflight": float(self.inflight),
+            "draining": bool(self.draining),
+        }
+        if hasattr(self.instance, "engine_load"):
+            try:
+                out.update(self.instance.engine_load())
+            except Exception:
+                pass  # engine mid-init: generic inflight still routes
+        return out
+
     def reconfigure(self, user_config):
         """Apply a user_config IN PLACE — no restart (reference:
         serve/_private/replica.py reconfigure)."""
@@ -188,6 +244,7 @@ class ServeController:
     def __init__(self):
         self.deployments: Dict[str, dict] = {}
         self.version = 0
+        self._fleet_m = None  # lazy util.metrics families (fleet plane)
         self._recover()
         # head fault tolerance: after this worker's CoreWorker reattaches
         # to a restarted head, re-sync replica state — probe every
@@ -197,6 +254,15 @@ class ServeController:
             self._core().on_reattach(self._schedule_resync)
         except Exception:
             pass  # no runtime yet (unit-test construction): resync is moot
+        # fleet plane: watchdog scale directives arrive on serve:fleet;
+        # a poller thread piggybacks replica load snapshots onto routing
+        # publishes (least-pressure routing needs a fleet-wide view the
+        # per-client inflight counter can't give)
+        try:
+            self._subscribe_fleet()
+            self._start_load_poller()
+        except Exception:
+            pass  # unit-test construction without a cluster
 
     def _schedule_resync(self):
         """Runs on the reattach-callback thread: route the resync through
@@ -341,18 +407,235 @@ class ServeController:
     def _publish_update(self, name: str):
         """Push the version bump to every handle (reference analog:
         LongPollHost notifying LongPollClients, _private/long_poll.py:184).
-        Handles mark themselves stale and re-pull on their next request."""
+        Handles mark themselves stale and re-pull on their next request.
+        Replica load snapshots piggyback on the same message — a handle
+        absorbs them without an RPC, and load-only publishes (same
+        version) never force a membership re-pull."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.protocol import MsgType
+
+        message: Dict[str, Any] = {"version": self.version}
+        dep = self.deployments.get(name)
+        if dep is not None:
+            message["replica_names"] = list(dep.get("replica_names", []))
+            message["loads"] = dict(dep.get("replica_loads") or {})
+        try:
+            cw = worker_mod._require_connected()
+            cw.request(
+                MsgType.PUBLISH,
+                {"channel": f"serve:{name}", "message": message},
+            )
+        except Exception:
+            pass  # handles still converge via their pull path
+
+    # ---------------------------------------------------------- fleet plane
+
+    def _subscribe_fleet(self):
+        """Scale directives from the head watchdog (gcs/server.py
+        _apply_slo_scale) arrive on the serve:fleet channel.  The pubsub
+        callback runs on the io thread and must not block, so it hands the
+        directive to a short-lived thread that routes it through our OWN
+        actor handle — same serialization rule as _schedule_resync: the
+        directive mutates deployment state on the actor executor, never
+        from a foreign thread."""
+        import threading
+
+        from ray_tpu._private import worker as worker_mod
+
+        cw = worker_mod._require_connected()
+
+        def _cb(msg):
+            threading.Thread(
+                target=self._dispatch_fleet_directive,
+                args=(dict(msg or {}),),
+                daemon=True,
+            ).start()
+
+        cw.subscribe("serve:fleet", _cb)
+
+    def _dispatch_fleet_directive(self, directive: dict):
+        import ray_tpu
+        from ray_tpu.serve.api import CONTROLLER_NAME
+
+        try:
+            me = ray_tpu.get_actor(CONTROLLER_NAME)
+            me.apply_fleet_directive.remote(directive)
+        except Exception:  # noqa: BLE001
+            logger.exception("fleet directive could not be scheduled")
+
+    def apply_fleet_directive(self, directive: dict):
+        """Apply ONE watchdog scale directive: scale_out adds a replica,
+        scale_in removes one through the graceful drain protocol.  Bounds
+        clamp HERE, not at the head — the controller owns goal state; the
+        watchdog only expresses pressure.  Directives move one replica at
+        a time: the watchdog's sustain/cooldown gating is the rate
+        limiter, and single steps keep an overshooting burn estimate from
+        doubling a fleet in one tick."""
+        op = directive.get("op")
+        name = directive.get("deployment")
+        dep = self.deployments.get(name)
+        if dep is None or op not in ("scale_out", "scale_in"):
+            return False
+        lo = max(1, int(directive.get("min_replicas", 1)))
+        hi = max(lo, int(directive.get("max_replicas", 8)))
+        cur = int(dep["target"])
+        want = min(hi, cur + 1) if op == "scale_out" else max(lo, cur - 1)
+        if want == cur:
+            return False
+        dep["target"] = want
+        self._reconcile(name)
+        self.version += 1
+        self._checkpoint()
+        self._publish_update(name)
+        direction = "out" if op == "scale_out" else "in"
+        try:
+            m = self._fleet_metrics()
+            m["scale_events_total"].inc(
+                1.0, tags={"deployment": name, "direction": direction}
+            )
+            m["replicas"].set(float(len(dep["replicas"])), tags={"deployment": name})
+        except Exception:
+            pass
+        self._fleet_event(
+            f"serve fleet scale_{direction}: {name} {cur}->{want}",
+            deployment=name,
+            op=op,
+            target=want,
+            slo=str(directive.get("slo", "")),
+        )
+        return True
+
+    def _fleet_metrics(self):
+        """Lazy util.metrics families — the controller is a connected
+        worker, so its series land in the head KV like any app metric and
+        merge with the handle-side failover counters."""
+        if self._fleet_m is None:
+            from ray_tpu.util import metrics as metrics_mod
+
+            self._fleet_m = {
+                "replicas": metrics_mod.Gauge(
+                    "ray_tpu_serve_fleet_replicas",
+                    description="live replicas per serve deployment",
+                    tag_keys=("deployment",),
+                ),
+                "scale_events_total": metrics_mod.Counter(
+                    "ray_tpu_serve_fleet_scale_events_total",
+                    description="fleet scale directives applied, by direction",
+                    tag_keys=("deployment", "direction"),
+                ),
+                "failovers_total": metrics_mod.Counter(
+                    "ray_tpu_serve_fleet_failovers_total",
+                    description="mid-stream replica failovers (handle resubmits)",
+                    tag_keys=("deployment",),
+                ),
+                "drained_total": metrics_mod.Counter(
+                    "ray_tpu_serve_fleet_drained_total",
+                    description="replicas retired on scale-in, by outcome",
+                    tag_keys=("deployment", "outcome"),
+                ),
+            }
+        return self._fleet_m
+
+    def _init_fleet_metrics(self, name: str):
+        """Zero-init every fleet family for a deployment so the scrape
+        endpoint exposes all four the moment it exists (prom_validate
+        gates on family presence; failovers increment from HANDLE
+        processes, which may never run in this one)."""
+        try:
+            m = self._fleet_metrics()
+            dep = self.deployments.get(name) or {}
+            m["replicas"].set(
+                float(len(dep.get("replicas", []))), tags={"deployment": name}
+            )
+            m["failovers_total"].inc(0.0, tags={"deployment": name})
+            m["drained_total"].inc(0.0, tags={"deployment": name, "outcome": "clean"})
+            for direction in ("out", "in"):
+                m["scale_events_total"].inc(
+                    0.0, tags={"deployment": name, "direction": direction}
+                )
+        except Exception:
+            pass  # no cluster (unit test): metrics are moot
+
+    def _fleet_event(self, message: str, **fields):
+        """source=serve_fleet timeline event, fire-and-forget (same rule
+        as chaos strikes: bookkeeping must not park the control path on a
+        head that is mid-restart)."""
         from ray_tpu._private import worker as worker_mod
         from ray_tpu._private.protocol import MsgType
 
         try:
             cw = worker_mod._require_connected()
-            cw.request(
-                MsgType.PUBLISH,
-                {"channel": f"serve:{name}", "message": {"version": self.version}},
-            )
         except Exception:
-            pass  # handles still converge via their pull path
+            return
+        payload = {
+            "severity": "INFO",
+            "source": "serve_fleet",
+            "message": message,
+            "fields": fields,
+        }
+
+        async def _send():
+            try:
+                await cw.conn.send(MsgType.RECORD_EVENT, payload)
+            except (ConnectionError, OSError):
+                pass
+
+        try:
+            cw.io.spawn(_send())
+        except Exception:  # graftlint: disable=silent-except -- event bookkeeping is best-effort; the state change already landed
+            pass
+
+    def _start_load_poller(self):
+        import threading
+
+        t = threading.Thread(
+            target=self._load_poller_loop, daemon=True, name="serve-load-poller"
+        )
+        t.start()
+
+    def _load_poller_loop(self):
+        """Poll every replica's load() each serve_load_poll_period_s and
+        piggyback the snapshots onto a same-version publish.  Runs on a
+        daemon thread: reads take list() snapshots and writes publish
+        REPLACEMENT dicts (the _resolve_replica_node rule), so the actor
+        thread never sees a half-mutated view."""
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu._private.config import RayConfig
+
+        while True:
+            _time.sleep(max(0.1, float(RayConfig.serve_load_poll_period_s)))
+            try:
+                for name, dep in list(self.deployments.items()):
+                    replicas = list(dep.get("replicas", []))
+                    names = list(dep.get("replica_names", []))
+                    if not replicas or len(replicas) != len(names):
+                        continue  # mid-mutation snapshot: next tick
+                    refs = []
+                    for r, rn in zip(replicas, names):
+                        try:
+                            refs.append((rn, r.load.remote()))
+                        except Exception:
+                            continue
+                    loads = {}
+                    for rn, ref in refs:
+                        try:
+                            loads[rn] = ray_tpu.get(ref, timeout=5)
+                        except Exception:
+                            continue  # dead/wedged replica: unreported
+                    dep["replica_loads"] = loads
+                    self._publish_update(name)
+                    try:
+                        self._fleet_metrics()["replicas"].set(
+                            float(len(replicas)), tags={"deployment": name}
+                        )
+                    except Exception:
+                        pass
+            except Exception:  # noqa: BLE001
+                # a torn-down cluster mid-poll must not kill the thread
+                # with a stack trace storm; next tick re-probes
+                _time.sleep(1.0)
 
     def deploy(
         self,
@@ -443,6 +726,7 @@ class ServeController:
         self.version += 1
         self._checkpoint()
         self._publish_update(name)
+        self._init_fleet_metrics(name)
         if old:
             # retire the previous generation OFF the actor's call path: the
             # controller must keep serving get_handles (handles are
@@ -558,21 +842,91 @@ class ServeController:
         return old
 
     def _reconcile(self, name: str):
-        import ray_tpu
-
         dep = self.deployments[name]
         while len(dep["replicas"]) < dep["target"]:
             h, rname = self._spawn_replica(dep)
             dep["replicas"].append(h)
             dep["replica_names"].append(rname)
+        victims = []
         while len(dep["replicas"]) > dep["target"]:
+            # scale-in is GRACEFUL: the victim leaves the routing lists
+            # now (the caller's publish stops new traffic), stops
+            # admitting (start_drain), and a background drainer waits out
+            # its in-flight work before teardown — zero dropped requests
+            # on scale-in (serve/FLEET.md drain protocol)
             victim = dep["replicas"].pop()
             gone = dep["replica_names"].pop()
             dep.get("replica_nodes", {}).pop(gone, None)
+            victims.append((victim, gone))
+        if victims:
+            self._drain_replicas(name, victims)
+
+    def _drain_replicas(self, name: str, victims: list):
+        import threading
+
+        for victim, _ in victims:
+            try:
+                victim.start_drain.remote()
+            except Exception:
+                pass  # dead already: the drainer treats it as retired
+        threading.Thread(
+            target=self._drain_and_kill, args=(name, victims), daemon=True
+        ).start()
+
+    def _drain_and_kill(self, name: str, victims: list):
+        """Background drainer: poll drain_status until every victim is
+        idle or RayConfig.serve_drain_deadline_s elapses, then kill.  A
+        victim that retires inside the window dies with nothing in
+        flight (outcome=clean); deadline escalation is the bounded
+        failure mode (outcome=deadline) — a wedged stream consumer must
+        not pin chips forever."""
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu._private.config import RayConfig
+        from ray_tpu.exceptions import GetTimeoutError
+
+        deadline = _time.time() + float(RayConfig.serve_drain_deadline_s)
+        pending = list(victims)
+        outcomes = {rn: "deadline" for _, rn in victims}
+        while pending and _time.time() < deadline:
+            refs = [(v, rn, v.drain_status.remote()) for v, rn in pending]
+            still = []
+            for v, rn, ref in refs:
+                try:
+                    st = ray_tpu.get(ref, timeout=10)
+                except GetTimeoutError:
+                    still.append((v, rn))  # busy (a long handler blocks)
+                    continue
+                except Exception:
+                    outcomes[rn] = "clean"  # already dead: nothing to drop
+                    continue
+                if st.get("idle"):
+                    outcomes[rn] = "clean"
+                else:
+                    still.append((v, rn))
+            pending = still
+            if pending:
+                _time.sleep(0.25)
+        for victim, rn in victims:
             try:
                 ray_tpu.kill(victim)
             except Exception:
                 pass
+        for _, rn in victims:
+            outcome = outcomes[rn]
+            try:
+                self._fleet_metrics()["drained_total"].inc(
+                    1.0, tags={"deployment": name, "outcome": outcome}
+                )
+            except Exception:
+                pass
+            self._fleet_event(
+                f"serve fleet drained replica {rn} ({outcome})",
+                deployment=name,
+                replica=rn,
+                outcome=outcome,
+            )
 
     def get_handles(self, name: str):
         dep = self.deployments.get(name)
@@ -584,6 +938,10 @@ class ServeController:
             # node hex per replica ("" while still resolving): handles
             # prefer same-node replicas (per-node proxy local-first path)
             "replica_nodes": [nodes.get(rn, "") for rn in dep["replica_names"]],
+            "replica_names": list(dep["replica_names"]),
+            # freshest load snapshots (the poller also pushes these over
+            # pubsub between pulls — least-pressure routing inputs)
+            "replica_loads": dict(dep.get("replica_loads") or {}),
             "max_concurrent_queries": dep["max_concurrent_queries"],
             "version": self.version,
         }

@@ -246,6 +246,16 @@ class StreamHub:
             for sid in dead:
                 self._streams.pop(sid, None)
 
+    def busy_count(self) -> int:
+        """Streams whose consumer has not finished draining — the drain
+        protocol's wait condition (serve/FLEET.md): a replica may not
+        tear down while a live stream's queued frames could still be
+        lost.  A finished-but-unclosed stream counts: its done frame is
+        out, but the consumer may still be pulling the ring tail."""
+        self.gc_finished()
+        with self._lock:
+            return len(self._streams)
+
 
 _hub: Optional[StreamHub] = None
 _hub_lock = named_lock("ray_tpu.serve.engine.transport._hub_lock")

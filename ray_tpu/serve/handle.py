@@ -2,23 +2,99 @@
 
 Analog of the reference's handle/router pair (reference:
 python/ray/serve/handle.py:225 RayServeHandle.remote →
-_private/router.py:221 ReplicaSet.assign_replica — round-robin with an
-in-flight cap per replica; config fan-out via LongPollClient,
-_private/long_poll.py:67).  Two r2-weak fixes live here:
+_private/router.py:221 ReplicaSet.assign_replica; config fan-out via
+LongPollClient, _private/long_poll.py:67).  Fleet behaviors live here
+(serve/FLEET.md):
 
 - in-flight accounting resolves on the core worker's io loop via
   on_object_done (no thread per request);
 - replica membership is PUSH-invalidated: the controller publishes on the
   ``serve:<deployment>`` pubsub channel at every version bump, the handle
   marks itself stale and re-pulls on the next request — long-poll
-  semantics without a poll loop.
+  semantics without a poll loop.  Load snapshots piggyback on the same
+  channel and are absorbed WITHOUT a re-pull;
+- routing is power-of-two-choices least-pressure over local in-flight +
+  fleet-reported queue depth and KV-page pressure, locality as tiebreak;
+- all replicas saturated raises a typed ``DeploymentBackpressureError``
+  (the proxy maps it to 503 + Retry-After) instead of over-admitting;
+- ``stream_tokens`` fails over mid-stream: a dead replica's stream is
+  resubmitted to a survivor and resumed from the delivered-token
+  frontier with duplicates suppressed (greedy decoding makes the replay
+  bit-identical), so clients see a latency blip, not an error.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 from typing import Any, Dict, List
+
+_FAILOVER_ATTEMPTS = 3  # replica deaths one stream absorbs before erroring
+
+# process-wide failover counter: handles live in driver/proxy processes,
+# so the series merges with the controller's zero-init of the family
+_failovers_counter = None
+_failovers_lock = threading.Lock()
+
+
+def _count_failover(deployment: str):
+    global _failovers_counter
+    try:
+        with _failovers_lock:
+            if _failovers_counter is None:
+                from ray_tpu.util import metrics as metrics_mod
+
+                _failovers_counter = metrics_mod.Counter(
+                    "ray_tpu_serve_fleet_failovers_total",
+                    description="mid-stream replica failovers (handle resubmits)",
+                    tag_keys=("deployment",),
+                )
+        _failovers_counter.inc(1.0, tags={"deployment": deployment})
+    except Exception:
+        pass  # metrics plane down: the failover itself still happened
+
+
+def _fleet_event(message: str, **fields):
+    """source=serve_fleet timeline event, fire-and-forget (failover is a
+    data-path action — bookkeeping must not add a blocking head RPC)."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.protocol import MsgType
+
+    try:
+        cw = worker_mod._require_connected()
+    except Exception:
+        return
+    payload = {
+        "severity": "WARNING",
+        "source": "serve_fleet",
+        "message": message,
+        "fields": fields,
+    }
+
+    async def _send():
+        try:
+            await cw.conn.send(MsgType.RECORD_EVENT, payload)
+        except (ConnectionError, OSError):
+            pass
+
+    try:
+        cw.io.spawn(_send())
+    except Exception:  # graftlint: disable=silent-except -- event bookkeeping is best-effort; the failover already landed
+        pass
+
+
+def _unwrap_cause(exc, types, limit: int = 8):
+    """First exception of `types` on the cause chain (RayTaskError keeps
+    the remote exception under .cause; __cause__ covers local re-raises).
+    Same walk the proxy uses for Retry-After extraction."""
+    e, seen = exc, 0
+    while e is not None and seen < limit:
+        if isinstance(e, types):
+            return e
+        e = getattr(e, "cause", None) or getattr(e, "__cause__", None)
+        seen += 1
+    return None
 
 
 def _rebuild_handle(name: str) -> "DeploymentHandle":
@@ -37,10 +113,15 @@ class DeploymentHandle:
         self._controller = controller
         self._replicas: List = []
         self._replica_nodes: List[str] = []
+        self._replica_names: List[str] = []
+        # replica name -> load snapshot; REPLACED whole (never mutated) by
+        # the pubsub callback, so readers need no lock
+        self._loads: Dict[str, dict] = {}
         self._my_node = self._resolve_my_node()
         self._max_inflight = 100
         self._version = -1
         self._rr = itertools.count()
+        self._rng = random.Random()
         # keyed by replica actor id (NOT slot index): releases after a
         # membership change must decrement the replica that actually served
         self._inflight: Dict[Any, int] = {}
@@ -78,6 +159,18 @@ class DeploymentHandle:
                 if _cb in subs:
                     subs.remove(_cb)
                 return
+            if isinstance(_msg, dict):
+                # load snapshots piggyback on every publish (controller
+                # poller, ~1 Hz): absorb them here — dict REPLACEMENT, io
+                # thread never blocks — and only force a membership
+                # re-pull when the version actually moved; a load-only
+                # publish must not turn push-invalidation into 1 Hz
+                # controller RPCs per handle
+                loads = _msg.get("loads")
+                if isinstance(loads, dict):
+                    h._loads = dict(loads)
+                if _msg.get("version", -2) == h._version:
+                    return
             h._stale.set()
 
         try:
@@ -100,6 +193,11 @@ class DeploymentHandle:
             self._replica_nodes = info.get("replica_nodes") or [""] * len(
                 self._replicas
             )
+            self._replica_names = info.get("replica_names") or [""] * len(
+                self._replicas
+            )
+            if isinstance(info.get("replica_loads"), dict):
+                self._loads = dict(info["replica_loads"])
             self._max_inflight = info["max_concurrent_queries"]
             self._version = info["version"]
             live = {self._rid(r) for r in self._replicas}
@@ -127,8 +225,36 @@ class DeploymentHandle:
         except Exception:
             return ""
 
-    def _pick_replica(self):
+    def _pressure(self, idx: int) -> float:
+        """Routing pressure for replica slot ``idx``: what THIS handle has
+        in flight there, plus the fleet-reported queue depth and KV-page
+        pressure from the controller's piggybacked load snapshots.
+        max(local, reported-inflight) because the report already counts
+        our own in-flight work — summing would double-charge it."""
+        rid = self._rid(self._replicas[idx])
+        local = float(self._inflight.get(rid, 0))
+        ld = {}
+        if idx < len(self._replica_names):
+            ld = self._loads.get(self._replica_names[idx]) or {}
+        reported = float(ld.get("inflight", 0.0) or 0.0)
+        queue = float(ld.get("queue_depth", 0.0) or 0.0)
+        page_frac = float(ld.get("kv_page_frac", 0.0) or 0.0)
+        # page pressure scales by the admission cap so a nearly-full KV
+        # pool weighs like a nearly-full queue, not like one request
+        return max(local, reported) + queue + page_frac * self._max_inflight
+
+    def _pick_replica(self, exclude=frozenset()):
+        """Least-pressure routing with power-of-two-choices: sample two
+        eligible replicas, take the lower pressure, locality breaking
+        ties (tiebreak, NOT filter — a saturated local replica loses to
+        an idle remote one).  Eligible = under this handle's in-flight
+        cap, not reported draining, not in ``exclude`` (the failover
+        loop's dead-replica set).  Nothing eligible raises a typed
+        ``DeploymentBackpressureError`` — the cap is a real bound, not a
+        suggestion; the proxy maps it to 503 + Retry-After."""
         import time as _time
+
+        from ray_tpu.exceptions import DeploymentBackpressureError
 
         now = _time.monotonic()
         need = self._stale.is_set() or now - self._last_refresh > self.PULL_FALLBACK_S
@@ -154,28 +280,35 @@ class DeploymentHandle:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(f"deployment {self._name} has no replicas")
-            # local-first: replicas on THIS node get priority (per-node
-            # proxies route to their own node's replicas, reference:
-            # http_proxy.py prefer-local routing) — fall through to the
-            # whole set when no local replica is under its cap
-            pools = [list(range(n))]
-            if self._my_node and len(self._replica_nodes) == n:
-                local = [
-                    i for i in range(n) if self._replica_nodes[i] == self._my_node
-                ]
-                if local and len(local) < n:
-                    pools.insert(0, local)
-            for pool in pools:
-                # round-robin, skipping replicas at their in-flight cap
-                for _ in range(len(pool)):
-                    idx = pool[next(self._rr) % len(pool)]
-                    rid = self._rid(self._replicas[idx])
-                    if self._inflight.get(rid, 0) < self._max_inflight:
-                        self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                        return rid, self._replicas[idx]
-            # all saturated: take the round-robin pick anyway (backpressure
-            # belongs to the replica's queue)
-            idx = next(self._rr) % n
+            loads = self._loads  # replacement-dict snapshot
+            cands = []
+            for i in range(n):
+                rid = self._rid(self._replicas[i])
+                if rid in exclude:
+                    continue
+                if self._inflight.get(rid, 0) >= self._max_inflight:
+                    continue
+                rn = self._replica_names[i] if i < len(self._replica_names) else ""
+                if (loads.get(rn) or {}).get("draining"):
+                    continue  # mid-drain: admits nothing new
+                cands.append(i)
+            if not cands:
+                raise DeploymentBackpressureError(
+                    f"deployment {self._name}: all {n} replicas saturated "
+                    f"(cap {self._max_inflight})",
+                    retry_after_s=1.0,
+                )
+            if len(cands) > 2:
+                cands = self._rng.sample(cands, 2)
+            local_n = len(self._replica_nodes)
+
+            def _key(i):
+                is_remote = 1
+                if self._my_node and i < local_n:
+                    is_remote = 0 if self._replica_nodes[i] == self._my_node else 1
+                return (self._pressure(i), is_remote, i)
+
+            idx = min(cands, key=_key)
             rid = self._rid(self._replicas[idx])
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             return rid, self._replicas[idx]
@@ -217,64 +350,145 @@ class DeploymentHandle:
         co-located — no per-token RPC, no head hop).  Falls back to
         pulling the stream's outbox over the normal actor-call path when
         the direct transport is unavailable (client mode, feature off).
-        A dead replica raises a typed ``EngineStreamError`` mid-stream —
-        never a hang."""
+
+        Mid-stream replica death FAILS OVER (serve/FLEET.md): the
+        ORIGINAL request is resubmitted to a surviving replica and the
+        first ``delivered`` tokens of the replay are suppressed — greedy
+        decoding over identical weights makes them bit-identical, so the
+        resumed stream continues exactly where the dead one stopped.  A
+        replica-local overload or drain rejection retries the
+        next-least-loaded sibling without counting as a failover.  Only
+        when no survivor remains does the typed error (``EngineStream
+        Error`` / ``DeploymentBackpressureError``) reach the caller."""
+        from ray_tpu.exceptions import (
+            DeploymentBackpressureError,
+            EngineOverloadedError,
+            EngineStreamError,
+            RayActorError,
+            ReplicaDrainingError,
+            WorkerCrashedError,
+        )
+
+        delivered = 0
+        excluded = set()
+        failovers = 0
+        last_err = None
+        while True:
+            try:
+                rid, replica = self._pick_replica(exclude=frozenset(excluded))
+            except DeploymentBackpressureError:
+                if last_err is not None:
+                    raise last_err  # survivors exhausted: the stream death wins
+                raise
+            try:
+                skip = delivered
+                for frame in self._stream_once(
+                    replica, prompt, max_new_tokens, eos_token, timeout
+                ):
+                    if skip:
+                        # resumed stream: drop the already-delivered
+                        # prefix (bit-identical replay under greedy)
+                        if skip >= len(frame):
+                            skip -= len(frame)
+                            continue
+                        frame = frame[skip:]
+                        skip = 0
+                    delivered += len(frame)
+                    yield frame
+                return
+            except GeneratorExit:
+                raise  # consumer walked away: no retry on its behalf
+            except Exception as e:
+                retriable = _unwrap_cause(
+                    e, (EngineOverloadedError, ReplicaDrainingError)
+                )
+                if retriable is not None and delivered == 0:
+                    # admission-time rejection: try the next-least-loaded
+                    # sibling before shedding — a single replica's
+                    # overload is a routing miss, not a fleet 503
+                    excluded.add(rid)
+                    last_err = e
+                    continue
+                # WorkerCrashedError: the kill landed while the replica
+                # was still executing the submission call itself — same
+                # death, earlier phase, same failover
+                dead = _unwrap_cause(
+                    e,
+                    (
+                        EngineStreamError,
+                        RayActorError,
+                        WorkerCrashedError,
+                        ConnectionError,
+                    ),
+                )
+                if dead is None or failovers >= _FAILOVER_ATTEMPTS:
+                    raise
+                failovers += 1
+                excluded.add(rid)
+                last_err = e
+                self._stale.set()  # membership likely changed: re-pull
+                _count_failover(self._name)
+                _fleet_event(
+                    f"serve fleet failover: {self._name} stream resumed at "
+                    f"token {delivered}",
+                    deployment=self._name,
+                    delivered=delivered,
+                    attempt=failovers,
+                    error=type(dead).__name__,
+                )
+            finally:
+                self._release(rid)
+
+    def _stream_once(self, replica, prompt, max_new_tokens, eos_token, timeout):
+        """One streaming attempt against ONE replica; yields token-id
+        lists.  Replica death surfaces as a raised typed error — the
+        failover loop in stream_tokens owns retries and accounting."""
         import ray_tpu
         from ray_tpu.exceptions import EngineStreamError
         from ray_tpu.serve import tracing as serve_tracing
         from ray_tpu.serve.engine import transport as engine_transport
 
-        idx, replica = self._pick_replica()
+        trace = serve_tracing.new_request(self._name)
+        serve_tracing.stamp(trace, "serve_route")
+        kwargs = {"max_new_tokens": max_new_tokens, "eos_token": eos_token}
+        if trace is not None:
+            kwargs["_serve_trace"] = trace
+        start = ray_tpu.get(
+            replica.handle_request.remote("engine_stream_start", (prompt,), kwargs),
+            timeout=600,
+        )
         try:
-            trace = serve_tracing.new_request(self._name)
-            serve_tracing.stamp(trace, "serve_route")
-            kwargs = {"max_new_tokens": max_new_tokens, "eos_token": eos_token}
-            if trace is not None:
-                kwargs["_serve_trace"] = trace
-            start = ray_tpu.get(
-                replica.handle_request.remote("engine_stream_start", (prompt,), kwargs),
-                timeout=600,
-            )
-            try:
-                ts = engine_transport.open_token_stream(
-                    replica, start, timeout=timeout
+            ts = engine_transport.open_token_stream(replica, start, timeout=timeout)
+        except EngineStreamError:
+            ts = None  # no direct transport here: pull path below
+        if ts is not None:
+            yield from ts
+            return
+        sid = start["sid"]
+        finished = False
+        try:
+            while True:
+                frames, done = ray_tpu.get(
+                    replica.handle_request.remote("engine_stream_next", (sid,), {}),
+                    timeout=timeout,
                 )
-            except EngineStreamError:
-                ts = None  # no direct transport here: pull path below
-            if ts is not None:
-                yield from ts
-                return
-            sid = start["sid"]
-            finished = False
-            try:
-                while True:
-                    frames, done = ray_tpu.get(
-                        replica.handle_request.remote(
-                            "engine_stream_next", (sid,), {}
-                        ),
-                        timeout=timeout,
-                    )
-                    for f in frames:
-                        if f.get("error"):
-                            finished = True
-                            raise EngineStreamError(str(f["error"]))
-                        if f.get("t"):
-                            yield list(f["t"])
-                        if f.get("done"):
-                            finished = True
-                    if finished or done:
-                        return
-            finally:
-                if not finished:
-                    # abandoned mid-stream: free the replica-side request
-                    try:
-                        replica.handle_request.remote(
-                            "engine_stream_cancel", (sid,), {}
-                        )
-                    except Exception:
-                        pass
+                for f in frames:
+                    if f.get("error"):
+                        finished = True
+                        raise EngineStreamError(str(f["error"]))
+                    if f.get("t"):
+                        yield list(f["t"])
+                    if f.get("done"):
+                        finished = True
+                if finished or done:
+                    return
         finally:
-            self._release(idx)
+            if not finished:
+                # abandoned mid-stream: free the replica-side request
+                try:
+                    replica.handle_request.remote("engine_stream_cancel", (sid,), {})
+                except Exception:
+                    pass
 
     def method(self, method_name: str):
         handle = self

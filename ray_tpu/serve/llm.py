@@ -644,6 +644,38 @@ def engine_llm_deployment(
         def engine_stats(self):
             return self.engine.stats()
 
+        def engine_load(self):
+            """Cheap pressure snapshot for least-pressure routing
+            (serve/FLEET.md): queue depth, slot occupancy, and KV-page
+            fraction.  The Replica wrapper merges this into its load()
+            report, which the controller piggybacks onto routing
+            publishes — called at the load-poll period, so it must stay
+            allocation-light."""
+            st = self.engine.stats()
+            pages_total = float(st.get("pages_total", 0.0) or 0.0)
+            return {
+                "queue_depth": float(st.get("queue_depth", 0.0)),
+                "slots_active": float(st.get("slots_active", 0.0)),
+                "slots_total": float(st.get("slots_total", 0.0)),
+                "kv_page_frac": (
+                    float(st.get("pages_used", 0.0)) / pages_total
+                    if pages_total > 0
+                    else 0.0
+                ),
+            }
+
+        def engine_idle(self):
+            """Drain-completion predicate (serve/FLEET.md): True only
+            when the scheduler holds no queued or running requests AND
+            every hub stream's consumer finished draining its outbox —
+            a replica torn down earlier would drop frames a slow client
+            had not pulled yet."""
+            from ray_tpu.serve.engine import transport
+
+            st = self.engine.stats()
+            busy = st.get("queue_depth", 0.0) or st.get("slots_active", 0.0)
+            return not busy and transport.hub().busy_count() == 0
+
         def defrag(self):
             return self.engine.defrag()
 

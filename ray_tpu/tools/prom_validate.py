@@ -270,6 +270,29 @@ def _live_scrape() -> str:
             [handle.remote({"prompt": [i + 1, i + 2]}) for i in range(3)],
             timeout=600,
         )
+        # fleet plane: provoke one scale-out then a drain-backed
+        # scale-in on the engine deployment so the
+        # ray_tpu_serve_fleet_* families (replicas gauge, scale events,
+        # drained outcomes) carry a real elastic-scaling cycle — not
+        # just their zero-init — in the scrape under validation
+        from ray_tpu.serve.api import CONTROLLER_NAME
+
+        ctrl = _rt.get_actor(CONTROLLER_NAME)
+        for op in ("scale_out", "scale_in"):
+            applied = _rt.get(
+                ctrl.apply_fleet_directive.remote(
+                    {
+                        "op": op,
+                        "deployment": "prom_llm",
+                        "min_replicas": 1,
+                        "max_replicas": 2,
+                        "slo": "prom_validate",
+                    }
+                ),
+                timeout=300,
+            )
+            if applied is not True:
+                raise RuntimeError(f"fleet directive {op} was not applied")
         # multi-tenant plane: provoke one preemption so the
         # ray_tpu_preemptions_total counter family (and the preempted
         # task's typed PreemptedError path) is live in the scrape under
@@ -324,6 +347,10 @@ def _live_scrape() -> str:
                     "ray_tpu_slo_ok" in text
                     and "ray_tpu_shm_used_bytes" in text
                     and "ray_tpu_serve_engine_slots" in text
+                    and "ray_tpu_serve_fleet_replicas" in text
+                    and "ray_tpu_serve_fleet_scale_events_total" in text
+                    and "ray_tpu_serve_fleet_failovers_total" in text
+                    and "ray_tpu_serve_fleet_drained_total" in text
                     and "ray_tpu_preemptions_total" in text
                     and _profiler_samples_nonzero(text)
                 ):

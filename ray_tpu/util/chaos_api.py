@@ -139,6 +139,39 @@ def kill_worker(actor=None, pid: Optional[int] = None, sig: int = signal.SIGKILL
     return pid
 
 
+def kill_replica(deployment: str, index: int = 0, sig: int = signal.SIGKILL) -> int:
+    """SIGKILL the worker hosting one serve replica, named by
+    ``(deployment, index)`` instead of a fished-out actor id: resolves
+    the replica through the controller's routing info (the same
+    get_handles view handles route by).  Returns the pid struck.  The
+    fleet chaos gate scripts against this: a struck replica's in-flight
+    streams must fail over to a survivor (serve/FLEET.md)."""
+    import ray_tpu
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_handles.remote(deployment), timeout=30)
+    if info is None:
+        raise RuntimeError(f"no deployment named {deployment!r}")
+    replicas = info["replicas"]
+    if not 0 <= index < len(replicas):
+        raise IndexError(
+            f"replica index {index} out of range for {deployment!r} "
+            f"({len(replicas)} replicas)"
+        )
+    pid = _actor_pid(replicas[index])
+    chaos.kill_process(pid, sig)
+    _strike_event(
+        "chaos kill_replica",
+        deployment=deployment,
+        index=index,
+        replica=(info.get("replica_names") or [""] * len(replicas))[index],
+        pid=pid,
+        sig=int(sig),
+    )
+    return pid
+
+
 def suspend_worker(actor=None, pid: Optional[int] = None) -> int:
     """SIGSTOP the worker hosting `actor`: sockets stay open, heartbeats
     stop — the wedged-but-connected shape missed-beat expiry catches."""

@@ -23,6 +23,20 @@ chaos events) and export ``ray_tpu_slo_ok{slo}`` /
     ])
     slo_api.status()   # -> {"slos": [...verdicts...], "specs": [...]}
 
+Policy outputs ride the same specs: ``preempt_below_band`` (sustained
+burn evicts lower-band work, gcs/server.py _apply_slo_policy) and
+``scale_on_slo`` (sustained burn scales a serve deployment out, recovery
+scales it back in through the graceful drain protocol — serve/FLEET.md):
+
+    {"name": "ttft_p99_ms",
+     "metric": "ray_tpu_serve_ttft_seconds", "tags": {},
+     "quantile": 0.99, "threshold_ms": 400, "window_s": 30,
+     "scale_on_slo": {"deployment": "llm", "min_replicas": 1,
+                      "max_replicas": 4}}
+
+``scale_on_slo`` also accepts a bare deployment-name string (bounds
+default to 1..8).
+
 Specs persist in the head KV (``slo:specs``), so they survive driver
 exits and reach a head restarted from its WAL.
 """

@@ -155,6 +155,27 @@ def _parse_serve(path: str, run: str, table, notes: List[str]):
         notes.append(f"{run}: SERVE_BENCH run not comparable "
                      f"(rc={d.get('rc')}) — excluded from gated series")
         return
+    # fleet survival rows (serve/FLEET.md): scaling reaction, failover
+    # count and TTFT-under-kill are CONTROL-plane properties measured on
+    # a tiny CPU model by design, so — like the step-dispatch pair — they
+    # enter their series BEFORE the TPU-platform guard below.  Gated
+    # automatically once two runs carry them.
+    fleet = d.get("fleet") or {}
+    if isinstance(fleet.get("scale_out_reaction_s"), (int, float)):
+        _series("serve.fleet_scale_out_reaction_s",
+                fleet["scale_out_reaction_s"], run, table,
+                higher_is_better=False, tracked=True)
+    if isinstance(fleet.get("ttft_ms_p99_no_kill"), (int, float)):
+        _series("serve.fleet_ttft_ms_p99_no_kill",
+                fleet["ttft_ms_p99_no_kill"], run, table,
+                higher_is_better=False, tracked=True)
+    if isinstance(fleet.get("ttft_ms_p99_with_kill"), (int, float)):
+        _series("serve.fleet_ttft_ms_p99_with_kill",
+                fleet["ttft_ms_p99_with_kill"], run, table,
+                higher_is_better=False, tracked=True)
+    if isinstance(fleet.get("failovers"), (int, float)):
+        _series("serve.fleet_failovers_per_kill", fleet["failovers"], run,
+                table)  # informational: count, not a perf axis
     if d.get("platform") != "tpu":
         notes.append(f"{run}: SERVE_BENCH ran on {d.get('platform')!r} — "
                      "excluded from gated series")

@@ -438,31 +438,28 @@ def test_streaming_deployment(ray_cluster):
 
 
 def test_handle_prefers_local_replicas():
-    """Local-first pick: with locality known, a handle on node A sends to
-    A's replica while it has capacity, and falls through when saturated."""
+    """Locality is the routing TIEBREAK, not a filter (serve/FLEET.md):
+    at equal pressure a handle on node B picks B's replica; once the
+    local replica carries load the idle remote one wins, and a replica
+    at this handle's in-flight cap is ineligible entirely."""
     from ray_tpu.serve.handle import DeploymentHandle
-
-    h = DeploymentHandle.__new__(DeploymentHandle)  # no controller needed
-    import itertools
-    import threading
 
     class FakeReplica:
         def __init__(self, aid):
             self._actor_id = aid
 
-    h._name = "t"
+    h = DeploymentHandle("t", None)  # no controller needed
     h._replicas = [FakeReplica(b"a"), FakeReplica(b"b")]
+    h._replica_names = ["ra", "rb"]
     h._replica_nodes = ["node_a", "node_b"]
     h._my_node = "node_b"
     h._max_inflight = 2
     h._version = 1
-    h._rr = itertools.count()
-    h._inflight = {}
-    h._lock = threading.Lock()
-    h._stale = threading.Event()
     h._last_refresh = __import__("time").monotonic()
     h._last_refresh_attempt = h._last_refresh
 
-    picks = [h._pick_replica()[0] for _ in range(2)]
-    assert picks == [b"b", b"b"]  # local replica preferred until its cap
-    assert h._pick_replica()[0] == b"a"  # local saturated: falls through
+    assert h._pick_replica()[0] == b"b"  # equal pressure: local wins the tie
+    assert h._pick_replica()[0] == b"a"  # local carries load: idle remote wins
+    # remote at the cap is ineligible; the local replica still has a slot
+    h._inflight = {b"a": 2, b"b": 1}
+    assert h._pick_replica()[0] == b"b"
