@@ -103,6 +103,10 @@ _CONFIG_DEF: Dict[str, tuple] = {
     # -- logging / metrics --
     "event_loop_lag_warn_ms": (int, 500, "warn if the control loop stalls"),
     "metrics_report_period_ms": (int, 2000, "metrics push period"),
+    "log_rotation_bytes": (int, 64 * 1024 * 1024, "size-capped copytruncate rotation for worker-*.log (done by the tailing node agent; 0 disables); writers are O_APPEND so rotation never loses the write fd"),
+    "log_rotation_backups": (int, 2, "rotated .1..N backups kept per worker log; the log agent reads across the rotation seam"),
+    "driver_log_rate_lines_s": (int, 1000, "driver-side flood control: sustained per-source line rate printed to the driver terminal (2x burst); excess collapses into one suppression notice"),
+    "error_log_tail_lines": (int, 20, "captured log lines shipped inside structured error records and RayTaskError.log_tail (crash forensics)"),
     # -- serve --
     "serve_long_poll_timeout_s": (float, 30.0, "long-poll listen timeout"),
     "serve_queue_length_response_deadline_s": (float, 0.1, "router queue probe deadline"),

@@ -204,6 +204,19 @@ class MsgType(enum.IntEnum):
     # so the free fan-out rides their head conns).  Fire-and-forget.
     DEVICE_FREE = 115
 
+    # structured log plane (util/OBSERVABILITY.md "Logs"): LOG_FETCH is
+    # the pull-based retrieval RPC — client → head resolves an entity
+    # (worker/actor/task/replica/job/node) to its node's log files; the
+    # head serves its own node and forwards the resolved read to the
+    # owning raylet, which answers from disk (tail-N / cursor-ranged /
+    # follow-by-polling).  ERROR_REPORT is the resurrected ERROR_PUSH
+    # role at a NEW burned-in value (80 stays burned, see the retired
+    # list above): worker → head fire-and-forget structured error record
+    # (signature, traceback, last-K captured log lines) feeding the
+    # head-side dedup ring behind `ray-tpu summary errors`.
+    LOG_FETCH = 116
+    ERROR_REPORT = 117
+
 
 # Frames the chaos layer never injects into: its own control plane and
 # the structured-event channel fault reports ride on (keep in sync with

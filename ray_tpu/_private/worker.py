@@ -129,14 +129,20 @@ def init(
     from collections import deque
 
     global_worker.captured_logs = deque(maxlen=1000)  # bounded ring, test hook
+    job_hex = cw.job_id.binary().hex()
     if log_to_driver:
-        # worker stdout/stderr stream to the driver with a (source) prefix
-        # (reference: log_monitor.py → pubsub → driver print)
-        from ray_tpu._private.log_monitor import print_log_message
+        # worker stdout/stderr stream to the driver — job-scoped by the
+        # head (this subscription only receives records stamped with OUR
+        # job), rendered with the (ClassName pid=… node=…) prefix, rate-
+        # capped and repeat-collapsed by the sink (flood control)
+        from ray_tpu._private.log_monitor import DriverLogSink
+
+        sink = DriverLogSink(rate_lines_s=RayConfig.driver_log_rate_lines_s)
+        global_worker.driver_log_sink = sink
 
         def _on_log(msg: dict):
             global_worker.captured_logs.extend(msg.get("lines", []))
-            print_log_message(msg)
+            sink.feed(msg)
 
         try:
             cw.subscribe("logs", _on_log)
@@ -144,6 +150,19 @@ def init(
             print(
                 f"ray_tpu: worker-log streaming unavailable: {e}", file=sys.stderr
             )
+    # driver output joins the log plane: terminal bytes untouched, each
+    # completed line also teed as a structured record into the session
+    # dir, where the head's tailer makes it LOG_FETCH-addressable by job
+    if global_worker.session_dir:
+        from ray_tpu._private import log_plane
+
+        log_plane.install_driver_tee(
+            os.path.join(
+                global_worker.session_dir,
+                f"driver-{job_hex[:8]}-{os.getpid()}.log",
+            ),
+            job=job_hex,
+        )
     atexit.register(shutdown)
     return RuntimeContext(global_worker)
 
@@ -208,6 +227,13 @@ def shutdown():
     (reference: worker.py:1567)."""
     cw = global_worker.core_worker
     if cw is not None:
+        from ray_tpu._private import log_plane
+
+        log_plane.uninstall()  # unwind the driver tee; no-op otherwise
+        sink = getattr(global_worker, "driver_log_sink", None)
+        if sink is not None:
+            sink.flush()  # surface any pending "repeated N×" collapse
+            global_worker.driver_log_sink = None
         try:
             cw.disconnect()
         except Exception:  # noqa: BLE001

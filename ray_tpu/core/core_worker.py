@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ray_tpu._private import serialization
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.log_plane import LOG_TAIL_MARKER
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.protocol import Connection, MsgType
 from ray_tpu._private.serialization import SerializedObject
@@ -84,10 +85,23 @@ def _new_phases():
 
 
 def _error_from_string(msg: str) -> Exception:
+    # head-side crash forensics: the sealed reason may carry the victim's
+    # captured log tail appended as one marker line (gcs/server.py
+    # _with_log_tail) — split it off and attach it typed
+    log_tail = []
+    if LOG_TAIL_MARKER in msg:
+        msg, _, tail_json = msg.partition(LOG_TAIL_MARKER)
+        msg = msg.rstrip()
+        try:
+            import json as _json
+
+            log_tail = list(_json.loads(tail_json))
+        except ValueError:
+            log_tail = []
     head, _, rest = msg.partition(":")
     cls = _ERROR_CLASSES.get(head.strip())
     if cls is RayActorError or cls is ActorDiedError:
-        return cls(reason=rest.strip() or msg)
+        return cls(reason=rest.strip() or msg, log_tail=log_tail)
     if cls is TaskCancelledError:
         return TaskCancelledError()
     if cls is PreemptedError:
@@ -860,6 +874,23 @@ class CoreWorker:
             self.io.spawn(self.conn.send(MsgType.PROFILE_STATS, payload))
         except Exception:  # graftlint: disable=silent-except -- profiler frames are best-effort observability; the process-local totals remain the witness
             pass
+
+    def report_error(self, payload: dict):
+        """Fire-and-forget structured error record (ERROR_REPORT) to the
+        head's dedup ring — crash forensics, must never block or raise
+        into the task error path."""
+        if self.node_id:
+            payload = dict(payload, node_id=self.node_id)
+        try:
+            self.io.spawn(self.conn.send(MsgType.ERROR_REPORT, payload))
+        except Exception:  # graftlint: disable=silent-except -- forensics plane is best-effort; the stored RayTaskError is authoritative
+            pass
+
+    def fetch_log(self, payload: dict, timeout: float = 30.0) -> dict:
+        """LOG_FETCH: pull log records by entity (worker/actor/task/
+        replica/job/node) — the head resolves the entity and serves its
+        own node or forwards the read to the owning raylet."""
+        return self.request(MsgType.LOG_FETCH, payload, timeout=timeout)
 
     def _chaos_emit(self, ev: dict):
         """Fire-and-forget structured event for a fired fault (RECORD_EVENT
@@ -2902,6 +2933,7 @@ class CoreWorker:
         pid: int,
         has_tpu: bool = False,
         direct_addr: str = "",
+        log_file: str = "",
     ):
         reply = self.request(
             MsgType.REGISTER_WORKER,
@@ -2911,6 +2943,10 @@ class CoreWorker:
                 "pid": pid,
                 "has_tpu": has_tpu,
                 "direct_addr": direct_addr,
+                # where this worker's stdout/stderr land on its node —
+                # the head's LOG_FETCH entity resolution (worker/actor/
+                # task → file) starts here
+                "log_file": log_file,
             },
         )
         # registration echo for a post-restart reattach announce

@@ -24,7 +24,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
-from ray_tpu._private import serialization
+from ray_tpu._private import log_plane, serialization
 from ray_tpu._private.ids import JobID
 from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
 from ray_tpu.exceptions import RayTaskError
@@ -492,11 +492,18 @@ class WorkerRuntime:
                 ph["put_end"] = _time.time()
         except BaseException as e:  # noqa: BLE001
             name = spec.function_name or spec.method_name
+            # crash forensics: the last-K lines THIS process captured ride
+            # inside the error object to the driver and inside the
+            # ERROR_REPORT record to the head's dedup ring
+            tail = log_plane.recent_tail(RayConfig.error_log_tail_lines)
             if isinstance(e, RayTaskError):
                 err = e
+                if tail and not err.log_tail:
+                    err.log_tail = tail
             else:
-                err = RayTaskError.from_exception(name, e)
+                err = RayTaskError.from_exception(name, e, log_tail=tail)
             error = f"{type(e).__name__}: {e}"
+            self._report_error(spec, e, err, tail)
             # store the error as the value of every return object
             try:
                 for oid in spec.return_object_ids():
@@ -516,6 +523,7 @@ class WorkerRuntime:
             traceback.print_exc(file=sys.stderr)
         finally:
             self.cw.current_task_id = None
+            log_plane.clear_task_context()
         if direct:
             lease_mode = reply_to[0] == "lease"
             # over-limit / ref-containing results were stored: seal them at
@@ -567,6 +575,31 @@ class WorkerRuntime:
             traceback.print_exc(file=sys.stderr)
             os._exit(1)  # lost the head: die, the head treats it as worker death
 
+    def _report_error(self, spec: TaskSpec, exc: BaseException, err, tail):
+        """Fire-and-forget structured error record to the head's dedup
+        ring (ERROR_REPORT — the resurrected ERROR_PUSH role).  Never
+        raises: error reporting must not mask the task error itself."""
+        try:
+            tb = getattr(err, "traceback_str", "") or ""
+            name = spec.function_name or spec.method_name
+            self.cw.report_error(
+                {
+                    "signature": _error_signature(exc, name),
+                    "kind": "actor_task" if spec.actor_id else "task",
+                    "exc_type": type(exc).__name__,
+                    "message": str(exc)[:512],
+                    "name": name,
+                    "traceback": tb[-8192:],
+                    "log_tail": tail,
+                    "job_id": bytes(spec.job_id).hex() if spec.job_id else "",
+                    "task_id": bytes(spec.task_id).hex(),
+                    "actor_id": bytes(spec.actor_id).hex() if spec.actor_id else "",
+                    "pid": os.getpid(),
+                }
+            )
+        except Exception:  # graftlint: disable=silent-except -- forensics plane is best-effort; the task error itself is already stored
+            pass
+
     def _apply_runtime_env(self, spec: TaskSpec):
         """env_vars / working_dir / py_modules / offline-pip-venv
         materialized in-process before execution (reference:
@@ -586,6 +619,18 @@ class WorkerRuntime:
         from ray_tpu.util.tracing import span_scope
 
         self.cw.current_task_id = spec.task_id
+        if log_plane.enabled:
+            # running-task identity for the structured log plane: every
+            # line this task prints is stamped with it (O(1) per line —
+            # one dict swap here, one dict merge per line)
+            cls = self.actor.cls
+            log_plane.task_context(
+                task=bytes(spec.task_id).hex(),
+                trace=(spec.trace_ctx or {}).get("trace_id") or None,
+                job=bytes(spec.job_id).hex() if spec.job_id else None,
+                actor=bytes(spec.actor_id).hex() if spec.actor_id else None,
+                cls=getattr(cls, "__name__", None) if spec.actor_id else None,
+            )
         with span_scope(spec.trace_ctx):
             return self._execute_inner(spec)
 
@@ -859,6 +904,30 @@ def _is_async_actor(cls) -> bool:
     )
 
 
+def _error_signature(exc: BaseException, name: str) -> str:
+    """Dedup key for the head's error ring: exception type + function +
+    deepest in-user-code frame.  Two crashes from the same broken line
+    collapse into one signature however many workers hit it."""
+    file, line = "", 0
+    tb = exc.__traceback__
+    while tb is not None:
+        file = os.path.basename(tb.tb_frame.f_code.co_filename)
+        line = tb.tb_lineno
+        tb = tb.tb_next
+    return f"{type(exc).__name__}:{name}:{file}:{line}"
+
+
+def _own_log_file() -> str:
+    """Where this process's stdout actually lands (the worker log the
+    raylet/zygote/head wired us to) — registered with the head so
+    LOG_FETCH can address this worker's output by entity."""
+    try:
+        path = os.readlink("/proc/self/fd/1")
+        return path if path.startswith("/") else ""
+    except OSError:
+        return ""
+
+
 def main():
     # stack dumps on demand: `kill -USR1 <worker pid>` writes every
     # thread's traceback to the worker log — the first tool for "which
@@ -875,9 +944,17 @@ def main():
     if os.environ.get("RAY_TPU_SYSTEM_CONFIG"):
         RayConfig.initialize_from_json(os.environ["RAY_TPU_SYSTEM_CONFIG"])
 
+    # structured log capture FIRST, so even registration-path output is
+    # stamped.  Covers exec-spawned workers and zygote-forked children
+    # alike — both re-enter main() with fd 1/2 already dup2'd onto the
+    # worker log (RAY_TPU_LOG_STRUCTURED=0 keeps raw lines; install is a
+    # no-op then).
+    log_plane.install(node=node_id.hex()[:8])
+
     from ray_tpu.core.core_worker import CoreWorker
 
     cw = CoreWorker(host, int(port), mode="worker")
+    log_plane.set_static(wid=cw.worker_id.hex()[:8])
     runtime = WorkerRuntime(cw)
     # handler must be live BEFORE registering: the head pushes the first task
     # the moment registration lands
@@ -894,6 +971,7 @@ def main():
         os.getpid(),
         has_tpu=bool(os.environ.get("RAY_TPU_WORKER_TPU")),
         direct_addr=f"0.0.0.0:{direct_port}" if direct_port else "",
+        log_file=_own_log_file(),
     )
     # node-local dispatch: announce to this node's raylet lease agent (if
     # any) so node-affine leases grant without a head round-trip

@@ -123,6 +123,53 @@ class DashboardServer:
             )
             return _json(reply)
 
+        async def api_logs(request):
+            """Entity-addressed log retrieval (?actor=|?task=|?replica=|
+            ?job=|?node=|?worker=ID, &tail=N, &grep=PAT) through the
+            head's LOG_FETCH resolution; ?errors=1 returns the
+            signature-deduped error aggregation instead."""
+            from ray_tpu._private import worker as worker_mod
+            from ray_tpu.experimental.state import summarize_errors
+
+            if request.query.get("errors"):
+                return _json(await _off(summarize_errors))
+            kind = None
+            ident = ""
+            for k in ("actor", "task", "replica", "job", "node", "worker"):
+                v = request.query.get(k)
+                if v:
+                    kind, ident = k, v
+                    break
+            if kind is None:
+                return web.json_response(
+                    {
+                        "error": "pick one of ?actor=|?task=|?replica=|"
+                        "?job=|?node=|?worker=ID (or ?errors=1)"
+                    },
+                    status=400,
+                )
+            try:
+                tail = int(request.query.get("tail", 100))
+            except ValueError:
+                tail = 100
+
+            def _fetch():
+                return worker_mod._require_connected().fetch_log(
+                    {
+                        "kind": kind,
+                        "id": ident,
+                        "tail": tail,
+                        "grep": request.query.get("grep") or None,
+                    }
+                )
+
+            reply = await _off(_fetch)
+            if not reply.get("ok"):
+                return web.json_response(
+                    {"error": reply.get("error", "log fetch failed")}, status=404
+                )
+            return _json(reply)
+
         async def api_events(request):
             from ray_tpu.experimental.state.api import list_cluster_events
 
@@ -195,7 +242,8 @@ class DashboardServer:
             <a href=/api/slo>slo</a>
             <a href=/api/profile>profile</a>
             <a href=/api/events>events</a>
-            <a href=/api/objects>objects</a></p>
+            <a href=/api/objects>objects</a>
+            <a href="/api/logs?errors=1">logs</a></p>
             </body></html>"""
             return web.Response(text=html, content_type="text/html")
 
@@ -211,6 +259,7 @@ class DashboardServer:
         app.router.add_get("/api/task_summary", api_task_summary)
         app.router.add_get("/api/slo", api_slo)
         app.router.add_get("/api/profile", api_profile)
+        app.router.add_get("/api/logs", api_logs)
         app.router.add_get("/api/events", api_events)
         app.router.add_get("/api/objects", api_objects)
         app.router.add_get("/api/serve/applications", api_serve_get)

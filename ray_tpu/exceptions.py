@@ -15,6 +15,14 @@ class RayError(Exception):
     """Base class for all framework errors."""
 
 
+def _tail_block(log_tail: list) -> str:
+    """Render a victim's captured log tail for an error message."""
+    if not log_tail:
+        return ""
+    body = "\n".join(f"    {ln}" for ln in log_tail)
+    return f"\nLast {len(log_tail)} log line(s) from the worker:\n{body}"
+
+
 class RayTaskError(RayError):
     """A task raised an exception; the traceback is carried to the caller.
 
@@ -23,16 +31,28 @@ class RayTaskError(RayError):
     reference: python/ray/exceptions.py RayTaskError.as_instanceof_cause).
     """
 
-    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+    def __init__(
+        self,
+        function_name: str,
+        traceback_str: str,
+        cause: Exception | None = None,
+        log_tail: list | None = None,
+    ):
         self.function_name = function_name
         self.traceback_str = traceback_str
         self.cause = cause
-        super().__init__(f"Task {function_name} failed:\n{traceback_str}")
+        # crash forensics (util/OBSERVABILITY.md "Logs"): the victim's
+        # last-K captured log lines ride inside the error, so a remote
+        # crash is diagnosable from the driver's `ray_tpu.get` alone
+        self.log_tail = list(log_tail) if log_tail else []
+        super().__init__(f"Task {function_name} failed:\n{traceback_str}{_tail_block(self.log_tail)}")
 
     @classmethod
-    def from_exception(cls, function_name: str, exc: Exception):
+    def from_exception(
+        cls, function_name: str, exc: Exception, log_tail: list | None = None
+    ):
         tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
-        return cls(function_name, tb, cause=exc)
+        return cls(function_name, tb, cause=exc, log_tail=log_tail)
 
     def __reduce__(self):
         # The cause crosses process boundaries only if it pickles; the
@@ -44,7 +64,10 @@ class RayTaskError(RayError):
             pickle.dumps(cause)
         except Exception:
             cause = None
-        return (RayTaskError, (self.function_name, self.traceback_str, cause))
+        return (
+            RayTaskError,
+            (self.function_name, self.traceback_str, cause, self.log_tail),
+        )
 
     def as_instanceof_cause(self):
         """Return an exception that is also an instance of the cause's class."""
@@ -69,7 +92,11 @@ class RayTaskError(RayError):
             err.function_name = self.function_name
             err.traceback_str = self.traceback_str
             err.cause = cause
-            err.args = (f"Task {self.function_name} failed:\n{self.traceback_str}",)
+            err.log_tail = list(self.log_tail)
+            err.args = (
+                f"Task {self.function_name} failed:\n{self.traceback_str}"
+                f"{_tail_block(self.log_tail)}",
+            )
             return err
         except TypeError:
             return self
@@ -84,9 +111,15 @@ class TaskCancelledError(RayError):
 class RayActorError(RayError):
     """The actor died before or during this method call."""
 
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+    def __init__(
+        self, actor_id=None, reason: str = "actor died", log_tail: list | None = None
+    ):
         self.actor_id = actor_id
-        super().__init__(f"Actor {actor_id}: {reason}")
+        # the victim's last captured log lines, enriched head-side from
+        # the logs pubsub ring when the actor's death is sealed — the
+        # dead process can't ship its own forensics
+        self.log_tail = list(log_tail) if log_tail else []
+        super().__init__(f"Actor {actor_id}: {reason}{_tail_block(self.log_tail)}")
 
 
 class ActorDiedError(RayActorError):

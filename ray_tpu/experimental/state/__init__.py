@@ -1,11 +1,14 @@
 from ray_tpu.experimental.state.api import (  # noqa: F401
+    get_log,
     list_actors,
     list_cluster_events,
+    list_logs,
     list_nodes,
     list_objects,
     list_placement_groups,
     list_tasks,
     slo_status,
+    summarize_errors,
     summarize_tasks,
     summarize_workloads,
 )

@@ -108,6 +108,94 @@ def profile_info(op: str = "status") -> Dict:
     return _cw().request(MsgType.PROFILE_CTRL, {"op": op})
 
 
+def get_log(
+    actor_id: str = "",
+    task_id: str = "",
+    replica: str = "",
+    job_id: str = "",
+    node_id: str = "",
+    worker_id: str = "",
+    tail: int = 100,
+    follow: bool = False,
+    grep: str = "",
+    _poll_s: float = 1.0,
+):
+    """Retrieve log lines for one entity through the head's LOG_FETCH
+    resolution (reference analog: state/api.py get_log).  Returns a list
+    of line strings; with ``follow=True`` returns a generator yielding
+    lines as they appear (poll-based, ctrl-c to stop)."""
+    import time as _time
+
+    from ray_tpu._private import log_plane
+
+    picked = [
+        (k, v)
+        for k, v in (
+            ("actor", actor_id),
+            ("task", task_id),
+            ("replica", replica),
+            ("job", job_id),
+            ("node", node_id),
+            ("worker", worker_id),
+        )
+        if v
+    ]
+    if len(picked) != 1:
+        raise ValueError(
+            "pass exactly one of actor_id/task_id/replica/job_id/node_id/worker_id"
+        )
+    kind, ident = picked[0]
+    cw = _cw()
+    reply = cw.fetch_log(
+        {"kind": kind, "id": ident, "tail": tail, "grep": grep or None}
+    )
+    if not reply.get("ok"):
+        raise RuntimeError(f"log fetch failed: {reply.get('error')}")
+
+    def _lines(records):
+        return [
+            f"{log_plane.record_prefix(r, r.get('src', ''))} {r.get('msg', '')}"
+            for r in records
+        ]
+
+    if not follow:
+        return _lines(reply.get("records") or [])
+
+    def _gen():
+        yield from _lines(reply.get("records") or [])
+        cursor = reply.get("cursor") or {}
+        while True:
+            _time.sleep(_poll_s)
+            r = cw.fetch_log(
+                {"kind": kind, "id": ident, "cursor": cursor, "grep": grep or None}
+            )
+            if not r.get("ok"):
+                raise RuntimeError(f"log follow failed: {r.get('error')}")
+            yield from _lines(r.get("records") or [])
+            nonlocal_cursor = r.get("cursor")
+            if nonlocal_cursor:
+                cursor = nonlocal_cursor
+
+    return _gen()
+
+
+def list_logs(node_id: str = "") -> List[str]:
+    """Log files known to the cluster (worker registrations + the head's
+    own session dir), as display strings ``node_hex:basename``.  Pass
+    ``node_id`` (hex prefix) to filter to one node."""
+    reply = _cw().fetch_log({"kind": "list", "id": node_id})
+    if not reply.get("ok"):
+        raise RuntimeError(f"list_logs failed: {reply.get('error')}")
+    return reply.get("files") or []
+
+
+def summarize_errors(limit: int = 0) -> Dict:
+    """The head's signature-deduped error aggregation (`summary errors`):
+    distinct crash signatures with first/last-seen + count, the error
+    counter family, and each signature's latest full record."""
+    return summarize_workloads("errors", limit)
+
+
 def list_cluster_events(limit: int = 1000) -> List[dict]:
     """Structured lifecycle events: node/actor/worker transitions, OOM
     kills, spill passes (reference analog: src/ray/util/event.h + the

@@ -33,6 +33,17 @@ async def _amain(args):
     )
     port = await server.start()
     print(f"PORT {port}", flush=True)
+    # join the structured log plane: stderr (→ head.log) is wrapped,
+    # stdout stays raw — it is the PORT handshake pipe the parent reads,
+    # not a log.  basicConfig's StreamHandler captured the REAL stderr at
+    # startup; re-point it at the wrapper so logging output is stamped
+    # once instead of landing raw beside a structured duplicate.
+    from ray_tpu._private import log_plane
+
+    if log_plane.install(node="head", wrap_stdout=False, logging_handler=False):
+        for h in logging.getLogger().handlers:
+            if isinstance(h, logging.StreamHandler) and h.stream is sys.stderr.raw:
+                h.stream = sys.stderr
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos
+from ray_tpu._private import log_plane as _log_plane
 from ray_tpu._private import profiler as _profiler
 from ray_tpu._private import task_events as _task_events
 from ray_tpu._private.config import RayConfig
@@ -92,6 +93,7 @@ class WorkerInfo:
         "has_tpu",
         "direct_addr",
         "lease",
+        "log_file",
     )
 
     def __init__(
@@ -114,6 +116,9 @@ class WorkerInfo:
         # active worker lease (control-plane fast path): {"lease_id",
         # "cid", "resources", "priority", "via", "granted_at", "revoking"}
         self.lease: Optional[dict] = None
+        # absolute path of the worker's log file on ITS node (from
+        # registration) — LOG_FETCH entity resolution starts here
+        self.log_file = ""
 
 
 class NodeInfo:
@@ -240,6 +245,7 @@ class ActorInfo:
         "restarts_used",
         "pending_calls",
         "death_cause",
+        "death_log_tail",
         "owner_conn_id",
         "direct_addr",
         "creation_cpu_released",
@@ -259,6 +265,10 @@ class ActorInfo:
         self.restarts_used = 0
         self.pending_calls: List[TaskSpec] = []
         self.death_cause = ""
+        # LOG_TAIL_MARKER suffix captured at death from the victim
+        # worker's recent-line ring; appended to every seal string so
+        # late calls to the dead actor still surface the forensics
+        self.death_log_tail = ""
         self.owner_conn_id: Optional[int] = None
         # "host:port" of the worker's direct-call server (reference analog:
         # the worker address a DirectActorSubmitter pushes to,
@@ -477,6 +487,27 @@ class HeadServer:
         self.profile_slices: "deque" = deque(maxlen=2048)
         self.profile_stack_dumps: List[dict] = []
         self.profile_ctrl: Optional[dict] = None
+
+        # ---- structured log plane (util/OBSERVABILITY.md "Logs") ----
+        # error ring + signature-dedup index behind `summary errors`
+        # (the resurrected ERROR_PUSH role, MsgType.ERROR_REPORT)
+        self.error_records: "deque" = deque(maxlen=512)
+        self._error_index: Dict[str, dict] = {}
+        # driver conn -> job id, for job-scoped "logs" fan-out (two
+        # concurrent drivers each see only their own workers' lines)
+        self._conn_job: Dict[int, bytes] = {}
+        # per-source recent-line ring fed by the logs pubsub transit:
+        # the forensics tail attached to ActorDiedError when the victim
+        # process died without shipping its own (source = log basename)
+        self._recent_logs: Dict[str, "deque"] = {}
+        # worker id -> {"node", "path", "src"}, kept past worker death
+        # (the ring above outlives the WorkerInfo; this is how a dead
+        # actor's seal finds its victim's tail, and how LOG_FETCH still
+        # resolves an exited worker's file)
+        self._worker_log_src: Dict[bytes, dict] = {}
+        # log records carrying trace ids, rendered into ray_tpu.timeline()
+        # as instant markers ("which line printed during which phase")
+        self._log_trace_marks: "deque" = deque(maxlen=2048)
 
         # ---- head fault tolerance (gcs/HEAD_FT.md) ----
         # per-boot incarnation: 1 on a fresh session, +1 per restart in
@@ -750,11 +781,38 @@ class HeadServer:
         def _publish_logs(msg: dict):
             asyncio.run_coroutine_threadsafe(self._publish("logs", msg), loop)
 
-        # head-spawned workers only — raylets tail their own node's files
+        # head-spawned workers only — raylets tail their own node's files.
+        # driver-*.log rides along: the driver tee (log_plane) lands its
+        # structured records there, making driver output job-addressable
         self._log_tailer = LogTailer(
-            self.session_dir, _publish_logs, pattern="worker-head-*.log"
+            self.session_dir,
+            _publish_logs,
+            pattern="worker-head-*.log|driver-*.log",
+            rotation_bytes=RayConfig.log_rotation_bytes,
+            rotation_backups=RayConfig.log_rotation_backups,
         )
         self._log_tailer.start()
+        # zero-init the log plane's metric families so scrapes see them
+        # before the first line / error flows (prom_validate contract)
+        self._inc_counter(
+            "ray_tpu_log_lines_total",
+            "log lines transiting the head's logs channel, by stream/node",
+            {"stream": "out", "node": "head"},
+            0.0,
+        )
+        self._inc_counter(
+            "ray_tpu_log_lines_total",
+            "log lines transiting the head's logs channel, by stream/node",
+            {"stream": "err", "node": "head"},
+            0.0,
+        )
+        for kind in ("task", "actor_task", "actor_death"):
+            self._inc_counter(
+                "ray_tpu_error_records_total",
+                "structured error records in the head's dedup ring, by kind",
+                {"kind": kind},
+                0.0,
+            )
         # table persistence: restore surviving metadata from a prior head
         # incarnation (detached actors restart on fresh workers; spilled /
         # lineage-backed objects stay recoverable), then append every
@@ -1604,6 +1662,7 @@ class HeadServer:
         actor.state = ACTOR_ALIVE
         actor.worker_id = w.worker_id
         actor.node_id = node.node_id
+        actor.death_log_tail = ""  # forensics from a prior incarnation
         if spec.name:
             self.named_actors[(spec.namespace, spec.name)] = aid
         if p.get("actor_direct_addr"):
@@ -1692,6 +1751,7 @@ class HeadServer:
                     w, self.nodes.get(w.node_id), reason="holder disconnected"
                 )
         kind = self._conn_kind.pop(cid, None)
+        self._conn_job.pop(cid, None)
         # device-tier holders served over this conn are gone with it
         if kind in ("worker", "driver"):
             self._device_drop_conn(cid)
@@ -1785,6 +1845,17 @@ class HeadServer:
         if node is None:
             raise ValueError("unknown node")
         w = WorkerInfo(wid, nid, conn, p["pid"], has_tpu=bool(p.get("has_tpu")))
+        # where the worker's stdout/stderr land on its node — the
+        # LOG_FETCH entity resolution (worker/actor/task → file)
+        w.log_file = str(p.get("log_file") or "")
+        if w.log_file:
+            self._worker_log_src[wid] = {
+                "node": nid,
+                "path": w.log_file,
+                "src": os.path.basename(w.log_file),
+            }
+            if len(self._worker_log_src) > 8192:
+                self._worker_log_src.pop(next(iter(self._worker_log_src)))
         if p.get("direct_addr"):
             # worker binds wildcard; its node's transfer address carries
             # the routable host (same derivation as actor direct addrs)
@@ -1807,6 +1878,9 @@ class HeadServer:
     async def h_register_driver(self, cid, conn, p):
         self._conn_kind[cid] = "driver"
         job_id = p.get("job_id", b"")
+        # job-scoped log streaming: this driver's "logs" subscription only
+        # receives records stamped with ITS job (or stamp-free lines)
+        self._conn_job[cid] = job_id
         self.jobs[job_id] = {"started_at": time.time(), "driver_pid": p.get("pid", 0)}
         self._wal("job", job_id, self.jobs[job_id])
         self._mark_tables_dirty()
@@ -2075,6 +2149,13 @@ class HeadServer:
             # a death MID-CREATION still holds the implicit creation CPU
             self._release_creation_cpu(actor, node, actor.creation_spec)
             node.release(self._actor_lifetime_resources(actor.creation_spec))
+        # crash forensics: snapshot the victim's recent lines NOW — the
+        # worker binding is cleared just below, after which neither
+        # _destroy_actor here nor a later exhausted-restart death can
+        # resolve worker → log file
+        actor.death_log_tail = (
+            self._with_log_tail(actor.worker_id) or actor.death_log_tail
+        )
         actor.worker_id = None
         actor.node_id = None
         actor.direct_addr = ""
@@ -2168,6 +2249,41 @@ class HeadServer:
             self._wal("kv", ckpt_key, None)
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        # crash forensics: snapshot the victim worker's recent lines
+        # (the ring keeps rolling for the worker's successor); a worker-
+        # death path already snapshotted in _on_actor_worker_dead before
+        # it cleared the binding — keep that copy.  Every seal of this
+        # actor's calls — current and future — carries the tail.
+        if not actor.death_log_tail:
+            actor.death_log_tail = self._with_log_tail(actor.worker_id)
+        if not reason.startswith(("ray.kill", "owner driver")):
+            # intentional teardown is not an error; faults and exhausted
+            # restart budgets are
+            tail_lines: List[str] = []
+            if actor.death_log_tail:
+                import json as _json
+
+                try:
+                    tail_lines = _json.loads(
+                        actor.death_log_tail[len(_log_plane.LOG_TAIL_MARKER) :]
+                    )
+                except (ValueError, TypeError):
+                    tail_lines = []
+            self._note_error_record(
+                {
+                    "signature": (
+                        f"ActorDeath:{actor.creation_spec.name}:"
+                        f"{reason.split('(')[0].strip()[:120]}"
+                    ),
+                    "kind": "actor_death",
+                    "exc_type": "ActorDiedError",
+                    "message": reason,
+                    "name": actor.creation_spec.name,
+                    "actor_id": actor.actor_id.hex(),
+                    "node_id": actor.node_id.hex() if actor.node_id else "",
+                    "log_tail": tail_lines,
+                }
+            )
         self._actor_mirror.upsert(
             actor.actor_id, state=ACTOR_DEAD, death_cause=reason, direct_addr=""
         )
@@ -2179,7 +2295,9 @@ class HeadServer:
         # fail queued calls
         for spec in actor.pending_calls:
             self._unpin_args(spec)
-            await self._seal_error_objects(spec, f"RayActorError: {reason}")
+            await self._seal_error_objects(
+                spec, f"RayActorError: {reason}{actor.death_log_tail}"
+            )
         actor.pending_calls.clear()
         # drop queued creation / calls in the scheduler queue (balancing
         # their submit-time arg pins)
@@ -3107,7 +3225,11 @@ class HeadServer:
             return {"ok": False}
         if actor.state == ACTOR_DEAD:
             self._unpin_args(spec)
-            await self._seal_error_objects(spec, f"RayActorError: {actor.death_cause or 'actor is dead'}")
+            await self._seal_error_objects(
+                spec,
+                f"RayActorError: {actor.death_cause or 'actor is dead'}"
+                f"{actor.death_log_tail}",
+            )
             return {"ok": False}
         if (
             actor.state in (ACTOR_PENDING, ACTOR_RESTARTING, ACTOR_PREEMPTED)
@@ -3207,6 +3329,9 @@ class HeadServer:
                 actor = self.actors.get(spec.actor_id)
                 if actor:
                     actor.state = ACTOR_ALIVE
+                    # a restarted incarnation must not inherit the previous
+                    # incarnation's death forensics
+                    actor.death_log_tail = ""
                     self._actor_mirror.upsert(actor.actor_id, state=ACTOR_ALIVE)
                     await self._publish("actor", {"actor_id": actor.actor_id, "state": ACTOR_ALIVE})
                     # flush queued calls in order
@@ -3845,6 +3970,14 @@ class HeadServer:
         return {"ok": True}
 
     async def _publish(self, channel: str, message: dict):
+        if channel == "logs":
+            self._account_log_message(message)
+            if str(message.get("source", "")).startswith("driver-"):
+                # driver-tee files are for LOG_FETCH retrieval only: the
+                # driver already printed these bytes to its own terminal,
+                # and streaming them back would echo through the tee →
+                # tailer → sink → tee loop, amplifying every line
+                return
         subs = self.subscribers.get(channel)
         if not subs:
             return
@@ -3853,12 +3986,363 @@ class HeadServer:
         # subscribe/unsubscribe, which would mutate the dict mid-iteration
         # (observed as a RuntimeError storm during mass worker death)
         for cid, conn in list(subs.items()):
+            msg = message
+            if channel == "logs":
+                msg = self._scope_log_message(cid, message)
+                if msg is None:
+                    continue  # nothing in this batch belongs to that driver
             try:
-                await conn.send(MsgType.PUBLISH, {"channel": channel, "message": message})
+                await conn.send(MsgType.PUBLISH, {"channel": channel, "message": msg})
             except Exception:  # graftlint: disable=silent-except -- dead subscriber is expected churn; pruned from the channel just below
                 dead.append(cid)
         for cid in dead:
             subs.pop(cid, None)
+
+    def _account_log_message(self, message: dict):
+        """Head-side transit accounting for one tailer batch: line
+        counters by stream/node, the per-source forensics ring (feeds
+        ActorDiedError.log_tail — a SIGKILLed victim can't ship its own
+        tail), and trace-stamped records for the timeline markers."""
+        records = message.get("records")
+        if not records:
+            return
+        source = message.get("source", "")
+        from collections import deque as _deque
+
+        ring = self._recent_logs.get(source)
+        if ring is None:
+            ring = self._recent_logs[source] = _deque(
+                maxlen=max(8, RayConfig.error_log_tail_lines)
+            )
+            if len(self._recent_logs) > 4096:
+                # bound source cardinality across very long sessions
+                self._recent_logs.pop(next(iter(self._recent_logs)))
+        by_stream: Dict[str, Dict[str, int]] = {}
+        for rec in records:
+            ring.append(rec.get("msg", ""))
+            stream = rec.get("stream", "out")
+            node = str(rec.get("node") or "head")
+            per = by_stream.setdefault(stream, {})
+            per[node] = per.get(node, 0) + 1
+            if rec.get("trace"):
+                self._log_trace_marks.append(rec)
+        for stream, per in by_stream.items():
+            for node, n in per.items():
+                self._inc_counter(
+                    "ray_tpu_log_lines_total",
+                    "log lines transiting the head's logs channel, by stream/node",
+                    {"stream": stream, "node": node},
+                    float(n),
+                )
+
+    def _scope_log_message(self, cid: int, message: dict) -> Optional[dict]:
+        """Job-scope one tailer batch for one subscriber: a driver conn
+        sees records stamped with ITS job plus stamp-free lines (raw mode,
+        infra output); non-driver subscribers see everything.  Returns
+        None when the filtered batch is empty."""
+        job = self._conn_job.get(cid)
+        if job is None:
+            return message  # not a registered driver: unscoped (tests, tools)
+        records = message.get("records")
+        if records is None:
+            return message  # v1 raw batch (structured capture off): unscoped
+        job_hex = bytes(job).hex()
+        kept = [
+            r for r in records if not r.get("job") or r.get("job") == job_hex
+        ]
+        if not kept:
+            return None
+        if len(kept) == len(records):
+            return message
+        return {
+            "source": message.get("source"),
+            "lines": [r.get("msg", "") for r in kept],
+            "records": kept,
+        }
+
+    def _with_log_tail(self, worker_id: Optional[bytes]) -> str:
+        """LOG_TAIL_MARKER suffix for a seal string: the victim worker's
+        last lines as seen by the logs pubsub transit.  The dead process
+        cannot ship its own forensics — this ring is the survivor copy.
+        Empty string when capture is off or nothing transited yet."""
+        if not worker_id or not _log_plane.enabled:
+            # RAY_TPU_LOG_STRUCTURED=0 contract: no sentinel-marked tail
+            # may enter a seal string — a worker printing the resulting
+            # exception would leak stamp bytes into a raw-mode log file
+            return ""
+        info = self._worker_log_src.get(bytes(worker_id))
+        ring = self._recent_logs.get(info["src"]) if info else None
+        if not ring:
+            return ""
+        import json as _json
+
+        try:
+            return _log_plane.LOG_TAIL_MARKER + _json.dumps(list(ring))
+        except (TypeError, ValueError):
+            return ""
+
+    def _note_error_record(self, p: dict):
+        """One structured error record into the head ring + signature
+        dedup index + counter family — shared by ERROR_REPORT frames and
+        head-side actor-death synthesis so `summary errors` sees both."""
+        sig = str(p.get("signature") or "unknown")
+        kind = str(p.get("kind") or "task")
+        rec = dict(p)
+        rec["ts"] = time.time()
+        self.error_records.append(rec)
+        ent = self._error_index.get(sig)
+        if ent is None:
+            if len(self._error_index) >= 1024:
+                # bound distinct-signature cardinality; oldest group goes
+                self._error_index.pop(next(iter(self._error_index)))
+            self._error_index[sig] = {
+                "signature": sig,
+                "kind": kind,
+                "first_ts": rec["ts"],
+                "last_ts": rec["ts"],
+                "count": 1,
+                "sample": rec,
+            }
+            # first sighting of a NEW signature is event-worthy; repeats
+            # only bump the dedup count (flood-safe by construction)
+            self._record_event(
+                "ERROR",
+                "errors",
+                f"{rec.get('exc_type', 'Error')} in {rec.get('name', '?')}: "
+                f"{str(rec.get('message', ''))[:200]}",
+                signature=sig,
+                kind=kind,
+            )
+        else:
+            ent["count"] += 1
+            ent["last_ts"] = rec["ts"]
+            ent["sample"] = rec
+        self._inc_counter(
+            "ray_tpu_error_records_total",
+            "structured error records received on the head error ring, by kind",
+            {"kind": kind},
+            1.0,
+        )
+
+    async def h_error_report(self, cid, conn, p):
+        """Resurrected ERROR_PUSH role (new burned-in value): a worker's
+        uncaught task/actor exception arrives as a structured record —
+        signature, traceback, last-K log lines — fire-and-forget (rid 0,
+        no reply)."""
+        self._note_error_record(p)
+        return {"ok": True}
+
+    # ------------------------------------------------- log plane: retrieval
+
+    def _resolve_log_entity(self, kind: str, ident: str):
+        """Entity → files on nodes.  Returns
+        ``(targets: {node_id: [paths]}, rec_filter: (key, hexprefix)|None,
+        job_hex|None)``; raises ValueError with a user-facing message when
+        the entity doesn't resolve."""
+        targets: Dict[bytes, List[str]] = {}
+        rec_filter = None
+        job_hex = None
+
+        def _add_worker(wid: bytes):
+            info = self._worker_log_src.get(bytes(wid))
+            if not info:
+                w = self.workers.get(bytes(wid))
+                if w is None or not w.log_file:
+                    raise ValueError(
+                        f"no log file known for worker {bytes(wid).hex()[:8]}"
+                    )
+                info = {"node": w.node_id, "path": w.log_file}
+            targets.setdefault(bytes(info["node"]), []).append(info["path"])
+
+        def _actor_worker(aid_hex: str) -> bytes:
+            for aid, actor in self.actors.items():
+                if aid.hex().startswith(aid_hex):
+                    if actor.worker_id is None:
+                        raise ValueError(
+                            f"actor {aid_hex[:8]} has no worker (state "
+                            f"{actor.state}): no log file to read"
+                        )
+                    return bytes(actor.worker_id)
+            raise ValueError(f"unknown actor {aid_hex[:8]}")
+
+        if kind == "worker":
+            for wid in list(self._worker_log_src) + list(self.workers):
+                if wid.hex().startswith(ident):
+                    _add_worker(wid)
+                    break
+            else:
+                raise ValueError(f"unknown worker {ident[:8]}")
+        elif kind == "actor":
+            wid = _actor_worker(ident)
+            _add_worker(wid)
+            rec_filter = ("actor", ident)
+        elif kind == "replica":
+            # "deployment#index": replicas are named actors
+            # SERVE_REPLICA::{deployment}::{gen}::{rseq} (serve/controller.py)
+            dep, _, idx = ident.partition("#")
+            idx = int(idx or 0)
+            prefix = f"SERVE_REPLICA::{dep}::"
+            names = sorted(
+                (name, aid)
+                for (_ns, name), aid in self.named_actors.items()
+                if name.startswith(prefix)
+            )
+            if not names:
+                raise ValueError(f"no live replicas for deployment {dep!r}")
+            if idx >= len(names):
+                raise ValueError(
+                    f"replica index {idx} out of range: deployment {dep!r} "
+                    f"has {len(names)} live replica(s)"
+                )
+            aid = names[idx][1]
+            wid = _actor_worker(bytes(aid).hex())
+            _add_worker(wid)
+            rec_filter = ("actor", bytes(aid).hex())
+        elif kind == "task":
+            # the running-task stamp addresses lines; read the whole
+            # cluster's files filtered down to this task's records
+            for info in self._worker_log_src.values():
+                targets.setdefault(bytes(info["node"]), []).append(info["path"])
+            rec_filter = ("task", ident)
+        elif kind == "job":
+            job_hex = ident
+            for info in self._worker_log_src.values():
+                targets.setdefault(bytes(info["node"]), []).append(info["path"])
+            # the driver tee lands on the head node as driver-{job8}-*.log
+            import glob as _glob
+
+            for path in _glob.glob(
+                os.path.join(self.session_dir, f"driver-{ident[:8]}*.log")
+            ):
+                targets.setdefault(self.head_node_id, []).append(path)
+        elif kind == "node":
+            for nid in self.nodes:
+                if nid.hex().startswith(ident):
+                    break
+            else:
+                raise ValueError(f"unknown node {ident[:8]}")
+            for info in self._worker_log_src.values():
+                if bytes(info["node"]) == nid:
+                    targets.setdefault(nid, []).append(info["path"])
+            if nid == self.head_node_id:
+                head_log = os.path.join(self.session_dir, "head.log")
+                if os.path.exists(head_log):
+                    targets.setdefault(nid, []).append(head_log)
+            if not targets:
+                raise ValueError(
+                    f"node {ident[:8]} has no registered worker logs yet"
+                )
+        else:
+            raise ValueError(f"unknown log entity kind {kind!r}")
+        return targets, rec_filter, job_hex
+
+    def _fetch_log_local(self, payload: dict) -> dict:
+        """The head is its own node's log agent (no raylet on the head):
+        same read the raylet-side agent performs, same session-dir jail."""
+        from ray_tpu._private import log_monitor
+
+        sess = os.path.realpath(self.session_dir)
+        files = [
+            f
+            for f in (payload.get("files") or [])
+            if os.path.realpath(f).startswith(sess + os.sep)
+        ]
+        cursor = payload.get("cursor") or None
+        grep = payload.get("grep") or None
+        job = payload.get("job") or None
+        if cursor:
+            recs, cur = log_monitor.read_new_records(cursor, grep=grep, job=job)
+        else:
+            recs, cur = log_monitor.tail_file_records(
+                files, tail=int(payload.get("tail") or 100), grep=grep, job=job
+            )
+        return {"ok": True, "records": recs, "cursor": cur}
+
+    async def _fetch_log_from(self, nid: bytes, payload: dict) -> dict:
+        if nid == self.head_node_id:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._fetch_log_local, payload
+            )
+        node = self.nodes.get(nid)
+        if node is None or node.conn is None or not node.alive:
+            return {
+                "ok": False,
+                "error": f"node {nid.hex()[:8]} is not reachable",
+            }
+        return await node.conn.request(MsgType.LOG_FETCH, payload, timeout=30)
+
+    async def h_log_fetch(self, cid, conn, p):
+        """Pull-based log retrieval: resolve the entity to files on nodes,
+        delegate the disk read to each node's log agent, merge by
+        timestamp.  ``cursor`` (from a prior reply) switches to a follow
+        read — only new complete lines since that reply."""
+        kind = str(p.get("kind") or "worker")
+        ident = str(p.get("id") or "")
+        tail = int(p.get("tail") or 100)
+        grep = p.get("grep") or None
+        cursor = p.get("cursor") or None
+
+        if kind == "list":
+            # directory view (state API list_logs): every log file the
+            # head can currently resolve, as node:basename strings
+            files = sorted(
+                {
+                    f"{bytes(info['node']).hex()[:12]}:{info['src']}"
+                    for info in self._worker_log_src.values()
+                    if not ident or bytes(info["node"]).hex().startswith(ident)
+                }
+            )
+            return {"ok": True, "files": files}
+
+        if cursor:
+            # follow: the reply cursor is {node_hex: {path: offset}} — route
+            # each sub-cursor back to the node that owns those files
+            jobs = [
+                (nh, {"cursor": sub, "grep": grep, "job": p.get("job") or None})
+                for nh, sub in cursor.items()
+                if sub
+            ]
+            records: List[dict] = []
+            out_cursor: Dict[str, dict] = {}
+            for nh, payload in jobs:
+                r = await self._fetch_log_from(bytes.fromhex(nh), payload)
+                if not r.get("ok"):
+                    return r
+                records.extend(r.get("records") or [])
+                out_cursor[nh] = r.get("cursor") or {}
+            records.sort(key=lambda r: r.get("ts") or 0.0)
+            return {"ok": True, "records": records, "cursor": out_cursor}
+
+        try:
+            targets, rec_filter, job_hex = self._resolve_log_entity(kind, ident)
+        except ValueError as e:
+            return {"ok": False, "error": str(e)}
+        if p.get("job") and not job_hex:
+            job_hex = str(p["job"])
+        records = []
+        out_cursor = {}
+        for nid, files in targets.items():
+            r = await self._fetch_log_from(
+                nid,
+                {"files": files, "tail": tail, "grep": grep, "job": job_hex},
+            )
+            if not r.get("ok"):
+                # partial reach (a node died mid-query) degrades, not fails,
+                # a multi-node read; a single-target read surfaces the error
+                if len(targets) == 1:
+                    return r
+                continue
+            records.extend(r.get("records") or [])
+            out_cursor[nid.hex()] = r.get("cursor") or {}
+        if rec_filter is not None:
+            key, prefix = rec_filter
+            records = [
+                r for r in records if str(r.get(key, "")).startswith(prefix)
+            ]
+        records.sort(key=lambda r: r.get("ts") or 0.0)
+        if tail > 0:
+            records = records[-tail:]
+        return {"ok": True, "records": records, "cursor": out_cursor}
 
     # -------------------------------------------------------- cluster state
 
@@ -4040,6 +4524,8 @@ class HeadServer:
             return self._summary_slo()
         if what == "preemptions":
             return self._summary_preemptions(limit)
+        if what == "errors":
+            return self._summary_errors(limit)
         if what == "head":
             return {
                 "incarnation": self.incarnation,
@@ -4828,6 +5314,29 @@ class HeadServer:
                         for k, v in ev.items()
                         if k not in ("timestamp", "message", "source")
                     },
+                }
+            )
+        # trace-stamped log records join the same view as instant markers:
+        # "which line printed during which traced phase" without leaving
+        # the timeline (records reach here via the logs pubsub transit)
+        for rec in self._log_trace_marks:
+            events.append(
+                {
+                    "name": f"log: {str(rec.get('msg', ''))[:120]}",
+                    "cat": "log",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": (rec.get("ts") or 0.0) * 1e6,
+                    "pid": rec.get("pid", 0),
+                    "tid": rec.get("pid", 0),
+                    "args": {
+                        "msg": rec.get("msg", ""),
+                        "stream": rec.get("stream", ""),
+                        "node": rec.get("node", ""),
+                        "task_id": rec.get("task", ""),
+                        "trace_id": rec.get("trace", ""),
+                    },
+                    "trace": {"trace_id": rec.get("trace", "")},
                 }
             )
         # sampled-stack slices (one per profiler flush window per process)
@@ -5755,6 +6264,49 @@ class HeadServer:
             "total": len(recs),
         }
 
+    def _summary_errors(self, limit: int = 0) -> dict:
+        """Backend of `ray-tpu summary errors`: the signature-dedup view
+        of the error ring — each distinct crash signature once, with
+        first/last-seen and a count, newest-first — plus the counter
+        family.  Dedup is the point: a hot loop throwing 10k times is ONE
+        row with count=10000, not 10k rows."""
+        counts: Dict[str, float] = {}
+        prefix = "metrics:ray_tpu_error_records_total:"
+        for key, rec in self._counter_cache.items():
+            if not key.startswith(prefix):
+                continue
+            tags = rec.get("tags") or {}
+            counts[f"kind={tags.get('kind', '?')}"] = rec.get("value", 0.0)
+        groups = sorted(
+            self._error_index.values(),
+            key=lambda g: g.get("last_ts", 0.0),
+            reverse=True,
+        )
+        if limit > 0:
+            groups = groups[:limit]
+        rows = []
+        for g in groups:
+            sample = g.get("sample") or {}
+            rows.append(
+                {
+                    "signature": g["signature"],
+                    "kind": g.get("kind", "task"),
+                    "count": g.get("count", 0),
+                    "first_ts": g.get("first_ts", 0.0),
+                    "last_ts": g.get("last_ts", 0.0),
+                    "exc_type": sample.get("exc_type", ""),
+                    "message": sample.get("message", ""),
+                    "name": sample.get("name", ""),
+                    "last": sample,
+                }
+            )
+        return {
+            "errors": rows,
+            "counts": counts,
+            "distinct": len(self._error_index),
+            "total": len(self.error_records),
+        }
+
     def _apply_slo_policy(self, spec: dict, verdict: dict, now: float):
         """SLO → policy: a sustained burn on a spec carrying
         ``preempt_below_band`` evicts the lowest-band victim instead of
@@ -6243,4 +6795,6 @@ HeadServer._HANDLERS = {
     MsgType.PROFILE_CTRL: HeadServer.h_profile_ctrl,
     MsgType.PROFILE_STATS: HeadServer.h_profile_stats,
     MsgType.REATTACH: HeadServer.h_reattach,
+    MsgType.LOG_FETCH: HeadServer.h_log_fetch,
+    MsgType.ERROR_REPORT: HeadServer.h_error_report,
 }

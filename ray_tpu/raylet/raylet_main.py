@@ -201,6 +201,8 @@ class Raylet:
             self.session_dir,
             _publish_logs,
             pattern=f"worker-{self.node_id.hex()[:8]}-*.log",
+            rotation_bytes=RayConfig.log_rotation_bytes,
+            rotation_backups=RayConfig.log_rotation_backups,
         )
         self._log_tailer.start()
 
@@ -411,6 +413,12 @@ class Raylet:
                     asyncio.get_running_loop().create_task(
                         self._handle_pull(conn, rid, payload)
                     )
+                elif msg_type == MsgType.LOG_FETCH:
+                    # per-node log agent: the head resolved the entity to
+                    # files on THIS node; serve the disk read off the loop
+                    asyncio.get_running_loop().create_task(
+                        self._handle_log_fetch(conn, rid, payload)
+                    )
                 elif msg_type == MsgType.OBJECT_DELETE:
                     for oid in payload.get("object_ids", []):
                         self.store.delete(bytes(oid))
@@ -451,6 +459,42 @@ class Raylet:
                 # head connection died while replying; the read loop's
                 # shutdown path owns cleanup
                 pass
+
+    async def _handle_log_fetch(self, conn: Connection, rid: int, payload: dict):
+        """Serve a resolved LOG_FETCH read from this node's disk: tail-N
+        across the rotation seam, or a cursor-ranged follow read.  File
+        paths were resolved by the head against entities IT owns; this
+        agent only reads session-dir logs (enforced below)."""
+        from ray_tpu._private import log_monitor
+
+        def _do():
+            sess = os.path.realpath(self.session_dir)
+            files = [
+                f
+                for f in (payload.get("files") or [])
+                if os.path.realpath(f).startswith(sess + os.sep)
+            ]
+            cursor = payload.get("cursor") or None
+            grep = payload.get("grep") or None
+            job = payload.get("job") or None
+            if cursor:
+                recs, cur = log_monitor.read_new_records(cursor, grep=grep, job=job)
+            else:
+                recs, cur = log_monitor.tail_file_records(
+                    files, tail=int(payload.get("tail") or 100), grep=grep, job=job
+                )
+            return {"ok": True, "records": recs, "cursor": cur}
+
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(None, _do)
+        except Exception as e:  # graftlint: disable=silent-except -- failure forwarded to the head inside the reply payload
+            result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        try:
+            await conn.reply(rid, result)
+        except (OSError, RuntimeError):
+            # head connection died while replying; the read loop's
+            # shutdown path owns cleanup
+            pass
 
     async def _handle_restore(self, conn: Connection, rid: int, payload: dict):
         from ray_tpu.raylet.spill import delete_spilled, restore_object
@@ -571,6 +615,14 @@ def main():
     args = parser.parse_args()
     host, port = args.head.rsplit(":", 1)
     raylet = Raylet(host, int(port), json.loads(args.resources), args.session_dir)
+    # the raylet's own stderr joins the structured plane too (stamped
+    # with its node id; no-op under RAY_TPU_LOG_STRUCTURED=0).  stdout
+    # stays raw: it is the "NODE <id>" handshake pipe the cluster
+    # launcher readline()s — a record-wrapped handshake never matches
+    # (same contract as the head's "PORT <n>" pipe)
+    from ray_tpu._private import log_plane
+
+    log_plane.install(node=raylet.node_id.hex()[:8], wrap_stdout=False)
 
     def _term(signum, frame):
         raylet.shutdown()

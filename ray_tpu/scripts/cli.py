@@ -299,6 +299,26 @@ def cmd_summary(args):
                 f"{rec.get('reason', '')}"
             )
         return 0
+    if args.what == "errors":
+        counts = reply.get("counts", {})
+        print(
+            f"== errors == {reply.get('distinct', 0)} distinct signatures, "
+            f"{reply.get('total', 0)} records in the ring"
+        )
+        for key, n in sorted(counts.items()):
+            print(f"  {key}: {n:.0f}")
+        for row in reply.get("errors", []):
+            first = time.strftime("%H:%M:%S", time.localtime(row.get("first_ts", 0)))
+            last = time.strftime("%H:%M:%S", time.localtime(row.get("last_ts", 0)))
+            print(
+                f"  x{row.get('count', 0):<5d} [{row.get('kind')}] "
+                f"{row.get('exc_type', '?')} in {row.get('name', '?')} "
+                f"(first {first}, last {last})"
+            )
+            msg = str(row.get("message", "")).splitlines()
+            if msg:
+                print(f"         {msg[0][:160]}")
+        return 0
     rows = reply.get("summary", [])
     if not rows:
         print(
@@ -426,6 +446,60 @@ def cmd_stacks(args):
     return 0
 
 
+def cmd_logs(args):
+    """`ray-tpu logs --actor|--task|--replica|--job|--node|--worker ID`:
+    pull-based log retrieval through the head's LOG_FETCH resolution —
+    tail-N by default, ``--follow`` switches to cursor polling."""
+    import ray_tpu
+    from ray_tpu._private import log_plane
+    from ray_tpu._private import worker as worker_mod
+
+    ray_tpu.init(address=_read_address(args))
+    cw = worker_mod._require_connected()
+    kind = None
+    ident = ""
+    for k in ("actor", "task", "replica", "job", "node", "worker"):
+        v = getattr(args, k, None)
+        if v:
+            kind, ident = k, v
+            break
+    if kind is None:
+        print(
+            "pick an entity: --actor/--task/--replica/--job/--node/--worker ID",
+            file=sys.stderr,
+        )
+        return 2
+
+    def _print(records):
+        for rec in records:
+            prefix = log_plane.record_prefix(rec, rec.get("src", ""))
+            print(f"{prefix} {rec.get('msg', '')}", flush=True)
+
+    reply = cw.fetch_log(
+        {"kind": kind, "id": ident, "tail": args.tail, "grep": args.grep}
+    )
+    if not reply.get("ok"):
+        print(f"log fetch failed: {reply.get('error')}", file=sys.stderr)
+        return 1
+    _print(reply.get("records") or [])
+    if not args.follow:
+        return 0
+    cursor = reply.get("cursor") or {}
+    try:
+        while True:
+            time.sleep(1.0)
+            reply = cw.fetch_log(
+                {"kind": kind, "id": ident, "cursor": cursor, "grep": args.grep}
+            )
+            if not reply.get("ok"):
+                print(f"log follow failed: {reply.get('error')}", file=sys.stderr)
+                return 1
+            _print(reply.get("records") or [])
+            cursor = reply.get("cursor") or cursor
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_slo(args):
     """`ray-tpu slo`: the watchdog's verdict per declared SLO."""
     import ray_tpu
@@ -489,10 +563,28 @@ def main():
 
     p = sub.add_parser("summary", help="workload summaries from the flight recorder")
     p.add_argument(
-        "what", choices=["tasks", "serve", "train", "memory", "preemptions", "head"]
+        "what",
+        choices=["tasks", "serve", "train", "memory", "preemptions", "head", "errors"],
     )
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser(
+        "logs", help="fetch logs by entity (worker/actor/task/replica/job/node)"
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument("--actor", default=None, help="actor id (hex, prefix ok)")
+    p.add_argument("--task", default=None, help="task id (hex, prefix ok)")
+    p.add_argument(
+        "--replica", default=None, help="serve replica as deployment#index"
+    )
+    p.add_argument("--job", default=None, help="job id (hex)")
+    p.add_argument("--node", default=None, help="node id (hex, prefix ok)")
+    p.add_argument("--worker", default=None, help="worker id (hex, prefix ok)")
+    p.add_argument("--tail", type=int, default=100, help="last N lines (default 100)")
+    p.add_argument("--follow", "-f", action="store_true", help="keep polling for new lines")
+    p.add_argument("--grep", default=None, help="only lines matching this regex")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("slo", help="SLO watchdog verdicts (exit 1 on a breach)")
     p.add_argument("--address", default=None)

@@ -28,6 +28,28 @@ logger = logging.getLogger(__name__)
 # or the drain protocol starves itself.
 _ADMIT_METHODS = frozenset({"engine_stream_start"})
 
+# Replica actor-name scheme.  This string format is a cross-layer
+# contract: the head resolves `ray-tpu logs --replica deployment#index`
+# by prefix-scanning its named-actor table for it (gcs/server.py
+# _resolve_log_entity), and a recovered controller re-acquires living
+# replicas the same way — change it in ONE place only.
+REPLICA_NAME_PREFIX = "SERVE_REPLICA"
+
+
+def replica_actor_name(deployment: str, gen: int = 0, rseq: int = 0) -> str:
+    return f"{REPLICA_NAME_PREFIX}::{deployment}::{gen}::{rseq}"
+
+
+def parse_replica_name(name: str) -> Optional[Dict[str, Any]]:
+    """Inverse of :func:`replica_actor_name`; None for non-replica names."""
+    parts = name.split("::")
+    if len(parts) != 4 or parts[0] != REPLICA_NAME_PREFIX:
+        return None
+    try:
+        return {"deployment": parts[1], "gen": int(parts[2]), "rseq": int(parts[3])}
+    except ValueError:
+        return None
+
 
 class Replica:
     """Replica actor body: hosts the user callable."""
@@ -783,7 +805,9 @@ class ServeController:
         _private/deployment_state.py ReplicaName)."""
         import ray_tpu
 
-        rname = f"SERVE_REPLICA::{dep['name']}::{dep.get('gen', 0)}::{dep.get('rseq', 0)}"
+        rname = replica_actor_name(
+            dep["name"], dep.get("gen", 0), dep.get("rseq", 0)
+        )
         dep["rseq"] = dep.get("rseq", 0) + 1
         actor_cls = ray_tpu.remote(Replica)
         opts = dict(dep["actor_options"])

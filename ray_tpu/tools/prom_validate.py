@@ -328,6 +328,21 @@ def _live_scrape() -> str:
             raise RuntimeError("hog survived preemption with a zero budget")
         except PreemptedError:
             pass
+        # log plane: provoke one structured error record so the
+        # ray_tpu_error_records_total family is live (and the log-line
+        # counter has transited worker output through the head)
+        from ray_tpu.exceptions import RayTaskError
+
+        @ray_tpu.remote
+        def crash():
+            print("prom_validate: about to crash")
+            raise ValueError("prom_validate provoked error")
+
+        try:
+            ray_tpu.get(crash.options(max_retries=0).remote(), timeout=60)
+            raise RuntimeError("crash task did not raise")
+        except RayTaskError:
+            pass
         # profiler plane: arm a 2s snapshot mid-scrape so the
         # ray_tpu_profiler_samples_total / _overhead_ratio families exist
         # in the document under validation, with the sample counter gated
@@ -352,6 +367,8 @@ def _live_scrape() -> str:
                     and "ray_tpu_serve_fleet_failovers_total" in text
                     and "ray_tpu_serve_fleet_drained_total" in text
                     and "ray_tpu_preemptions_total" in text
+                    and "ray_tpu_log_lines_total" in text
+                    and "ray_tpu_error_records_total" in text
                     and _profiler_samples_nonzero(text)
                 ):
                     return text
